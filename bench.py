@@ -1,0 +1,80 @@
+"""Benchmark: Higgs-1M-like GBDT training throughput on the local device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published Higgs result — 500 iterations of
+255-leaf trees over 10.5M x 28 in 130.094 s on 2xE5-2690v4
+(reference docs/Experiments.rst:104-121, see BASELINE.md). Scaled
+linearly to this bench's row count (histogram GBDT cost is ~linear in
+rows), i.e. baseline trees/sec at R rows = (500 / 130.094) * (10.5e6 / R).
+
+Env overrides: BENCH_ROWS, BENCH_FEATURES, BENCH_LEAVES, BENCH_TREES,
+BENCH_WARMUP, BENCH_MAX_BIN.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    feats = int(os.environ.get("BENCH_FEATURES", 28))
+    leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    trees = int(os.environ.get("BENCH_TREES", 10))
+    warmup = int(os.environ.get("BENCH_WARMUP", 2))
+    max_bin = int(os.environ.get("BENCH_MAX_BIN", 255))
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import lightgbm_tpu as lgb
+
+    rs = np.random.RandomState(17)
+    X = rs.randn(rows, feats).astype(np.float32)
+    w = rs.randn(feats)
+    logits = X[:, : feats // 2] @ w[: feats // 2] + np.sin(X[:, feats // 2]) * 2.0
+    y = (logits + rs.randn(rows) > 0).astype(np.float32)
+
+    params = {
+        "objective": "binary",
+        "num_leaves": leaves,
+        "max_bin": max_bin,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 20,
+        "metric": "auc",
+        "verbosity": -1,
+    }
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    ds.construct()
+
+    # warmup: compile + first trees
+    bst = lgb.train(dict(params), ds, num_boost_round=warmup)
+    t0 = time.time()
+    bst2 = lgb.train(dict(params), ds, num_boost_round=trees)
+    dt = time.time() - t0
+
+    trees_per_sec = trees / dt
+    baseline_tps = (500.0 / 130.094) * (10.5e6 / rows)
+    auc = None
+    try:
+        from sklearn.metrics import roc_auc_score
+
+        auc = float(roc_auc_score(y[:100000], bst2.predict(X[:100000])))
+    except Exception:
+        pass
+
+    out = {
+        "metric": f"higgs_synth_{rows // 1000}k_{leaves}leaves_trees_per_sec",
+        "value": round(trees_per_sec, 4),
+        "unit": "trees/sec",
+        "vs_baseline": round(trees_per_sec / baseline_tps, 4),
+    }
+    if auc is not None:
+        out["auc_100k"] = round(auc, 5)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

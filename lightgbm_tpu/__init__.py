@@ -1,0 +1,41 @@
+"""lightgbm_tpu — a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch reimplementation of the capabilities of LightGBM
+(reference: /root/reference, v4.6.0.99) designed TPU-first:
+
+- the dataset lives on device as a feature-major bin matrix,
+- feature histograms are built as one-hot matmuls on the MXU
+  (analog of reference src/treelearner/cuda/cuda_histogram_constructor.cu),
+- split finding is a vectorized cumulative-sum + masked argmax over all
+  (feature, threshold) pairs (analog of cuda_best_split_finder.cu),
+- data partition is a flat per-row leaf-id vector updated with masked
+  `where` (analog of cuda_data_partition.cu data_index_to_leaf_index),
+- distributed training shards rows over a `jax.sharding.Mesh` and reduces
+  histograms with `lax.psum`/`psum_scatter` over ICI (analog of
+  src/network/ reduce-scatter in data_parallel_tree_learner.cpp).
+
+The public Python API mirrors the reference python-package
+(`lightgbm.train`, `Dataset`, `Booster`, sklearn wrappers) so user code
+ports with an import change.
+"""
+
+from .basic import Booster, Dataset
+from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .engine import CVBooster, cv, train
+from .log import register_logger
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Booster",
+    "Dataset",
+    "CVBooster",
+    "cv",
+    "train",
+    "early_stopping",
+    "log_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+    "register_logger",
+    "__version__",
+]

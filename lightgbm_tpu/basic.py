@@ -1,0 +1,439 @@
+"""User-facing Dataset and Booster (reference python-package/lightgbm/basic.py).
+
+The reference Dataset (basic.py:1746) and Booster (basic.py:3543) wrap C
+handles over a ctypes ABI; here they wrap the host BinnedDataset and the
+GBDT driver directly — the "ABI" is the jit boundary. Construction is
+lazy like the reference: `Dataset.construct()` runs binning on first use
+so that `reference=` mapper sharing and `free_raw_data` semantics hold.
+"""
+
+from __future__ import annotations
+
+import copy
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import log
+from .boosting import GBDT
+from .config import Config
+from .dataset import BinnedDataset
+from .log import LightGBMError
+
+_ArrayLike = Union[np.ndarray, "list", "tuple"]
+
+
+def _to_2d_numpy(data: Any) -> Tuple[np.ndarray, Optional[List[str]]]:
+    feature_name = None
+    try:  # pandas support without importing pandas eagerly
+        import pandas as pd  # type: ignore
+
+        if isinstance(data, pd.DataFrame):
+            feature_name = [str(c) for c in data.columns]
+            return data.to_numpy(dtype=np.float64), feature_name
+        if isinstance(data, pd.Series):
+            return data.to_numpy(dtype=np.float64).reshape(-1, 1), None
+    except ImportError:
+        pass
+    if hasattr(data, "toarray"):  # scipy sparse
+        return np.asarray(data.toarray(), dtype=np.float64), None
+    arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr.astype(np.float64, copy=False), feature_name
+
+
+def _to_1d(v: Any) -> Optional[np.ndarray]:
+    if v is None:
+        return None
+    try:
+        import pandas as pd  # type: ignore
+
+        if isinstance(v, (pd.Series, pd.DataFrame)):
+            return v.to_numpy().ravel()
+    except ImportError:
+        pass
+    return np.asarray(v).ravel()
+
+
+class Dataset:
+    """Dataset wrapper (reference basic.py:1746)."""
+
+    def __init__(
+        self,
+        data: Any,
+        label: Any = None,
+        reference: Optional["Dataset"] = None,
+        weight: Any = None,
+        group: Any = None,
+        init_score: Any = None,
+        feature_name: Union[str, List[str]] = "auto",
+        categorical_feature: Union[str, List[Union[int, str]]] = "auto",
+        params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = True,
+        position: Any = None,
+    ):
+        self.data = data
+        self.label = _to_1d(label)
+        self.reference = reference
+        self.weight = _to_1d(weight)
+        self.group = _to_1d(group)
+        self.position = _to_1d(position)
+        self.init_score = _to_1d(init_score)
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = copy.deepcopy(params) or {}
+        self.free_raw_data = free_raw_data
+        self._binned: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self.pandas_categorical = None
+
+    # ------------------------------------------------------------------
+    def _resolve_categorical(self, feature_names: List[str]) -> List[int]:
+        cf = self.categorical_feature
+        if cf == "auto" or cf is None:
+            return []
+        out = []
+        for c in cf:
+            if isinstance(c, str):
+                if c in feature_names:
+                    out.append(feature_names.index(c))
+                else:
+                    log.warning(f"Unknown categorical feature {c}")
+            else:
+                out.append(int(c))
+        return out
+
+    def construct(self) -> "Dataset":
+        if self._binned is not None:
+            return self
+        if self.data is None:
+            log.fatal("Cannot construct Dataset: raw data was freed")
+        arr, pandas_names = _to_2d_numpy(self.data)
+        if isinstance(self.feature_name, list):
+            names = [str(n) for n in self.feature_name]
+        elif pandas_names is not None:
+            names = pandas_names
+        else:
+            names = [f"Column_{i}" for i in range(arr.shape[1])]
+        cfg = Config(self.params)
+        ref_binned = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_binned = self.reference._binned
+        cat = self._resolve_categorical(names)
+        keep_raw = bool(cfg.linear_tree)
+        self._binned = BinnedDataset.from_numpy(
+            arr,
+            cfg,
+            label=self.label,
+            weight=self.weight,
+            group=self.group,
+            init_score=self.init_score,
+            position=self.position,
+            categorical_feature=cat,
+            feature_names=names,
+            reference=ref_binned,
+            keep_raw=keep_raw,
+        )
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    # ------------------------------------------------------------------
+    def create_valid(
+        self, data, label=None, weight=None, group=None, init_score=None,
+        params=None, position=None,
+    ) -> "Dataset":
+        return Dataset(
+            data, label=label, reference=self, weight=weight, group=group,
+            init_score=init_score, params=params or self.params, position=position,
+        )
+
+    def set_label(self, label) -> "Dataset":
+        self.label = _to_1d(label)
+        if self._binned is not None:
+            self._binned.metadata.label = np.asarray(self.label, dtype=np.float32)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = _to_1d(weight)
+        if self._binned is not None:
+            self._binned.metadata.weight = (
+                np.asarray(self.weight, dtype=np.float32) if weight is not None else None
+            )
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = _to_1d(group)
+        if self._binned is not None:
+            self._binned.metadata.group = (
+                np.asarray(self.group, dtype=np.int64) if group is not None else None
+            )
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = _to_1d(init_score)
+        if self._binned is not None:
+            self._binned.metadata.init_score = (
+                np.asarray(self.init_score, dtype=np.float64)
+                if init_score is not None
+                else None
+            )
+        return self
+
+    def get_label(self):
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def num_data(self) -> int:
+        if self._binned is not None:
+            return self._binned.num_data
+        arr, _ = _to_2d_numpy(self.data)
+        return arr.shape[0]
+
+    def num_feature(self) -> int:
+        if self._binned is not None:
+            return self._binned.num_total_features
+        arr, _ = _to_2d_numpy(self.data)
+        return arr.shape[1]
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._binned.feature_names)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        idx = np.asarray(used_indices)
+        if self._binned is not None:
+            # binned-level subset (Dataset::CopySubrow): shares mappers,
+            # keeps all metadata incl. group/position
+            sub = Dataset.__new__(Dataset)
+            sub.__dict__.update(
+                data=None,
+                label=None if self.label is None else self.label[idx],
+                reference=self,
+                weight=None if self.weight is None else self.weight[idx],
+                group=None,
+                position=None if self.position is None else self.position[idx],
+                init_score=None if self.init_score is None else self.init_score[idx],
+                feature_name=self.feature_name,
+                categorical_feature=self.categorical_feature,
+                params=copy.deepcopy(params or self.params),
+                free_raw_data=self.free_raw_data,
+                _binned=self._binned.copy_subrow(idx),
+                used_indices=idx,
+                pandas_categorical=self.pandas_categorical,
+            )
+            sub.group = (
+                None if sub._binned.metadata.group is None
+                else np.asarray(sub._binned.metadata.group)
+            )
+            return sub
+        if self.data is None:
+            log.fatal("Cannot subset: raw data was freed")
+        arr, _ = _to_2d_numpy(self.data)
+        sub = Dataset(
+            arr[idx],
+            label=None if self.label is None else self.label[idx],
+            reference=self,
+            weight=None if self.weight is None else self.weight[idx],
+            position=None if self.position is None else self.position[idx],
+            init_score=None if self.init_score is None else self.init_score[idx],
+            feature_name=self.feature_name,
+            categorical_feature=self.categorical_feature,
+            params=params or self.params,
+            free_raw_data=self.free_raw_data,
+        )
+        sub.used_indices = idx
+        return sub
+
+
+class Booster:
+    """Booster wrapper (reference basic.py:3543)."""
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        train_set: Optional[Dataset] = None,
+        model_file: Optional[Union[str, Path]] = None,
+        model_str: Optional[str] = None,
+    ):
+        self.params = copy.deepcopy(params) or {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_data_name = "training"
+        self.pandas_categorical = None
+        self._network_initialized = False
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError(f"Training data should be Dataset instance, met {type(train_set).__name__}")
+            train_set.params = {**train_set.params, **self.params}
+            train_set.construct()
+            self.config = Config(train_set.params)
+            self._gbdt = GBDT(self.config, train_set._binned)
+            self.train_set = train_set
+            self._valid_sets: List[Dataset] = []
+            self._name_valid_sets: List[str] = []
+        elif model_file is not None or model_str is not None:
+            from .model_io import load_model_string
+
+            if model_file is not None:
+                model_str = Path(model_file).read_text()
+            self.config, self._gbdt = load_model_string(model_str)
+            self.train_set = None
+            self._valid_sets = []
+            self._name_valid_sets = []
+        else:
+            raise TypeError("At least one of train_set, model_file or model_str should be not None.")
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if not isinstance(data, Dataset):
+            raise TypeError(f"Validation data should be Dataset instance, met {type(data).__name__}")
+        if data.reference is not self.train_set:
+            data.reference = self.train_set
+        data.construct()
+        self._gbdt.add_valid(data._binned, name)
+        self._valid_sets.append(data)
+        self._name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration (basic.py:4052). Returns True if
+        training stopped (cannot split any more)."""
+        if train_set is not None and train_set is not self.train_set:
+            raise LightGBMError("Resetting train_set is not supported")
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        grad, hess = fobj(self.__inner_predict_raw(0), self.train_set)
+        return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration()
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees()
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_class
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self.config.update(params)
+        self._gbdt.shrinkage_rate = self.config.learning_rate
+        self._gbdt.params = None  # force re-derive
+        from .learner import make_split_params
+
+        self._gbdt.params = make_split_params(self.config)
+        return self
+
+    # ------------------------------------------------------------------
+    def __inner_predict_raw(self, data_idx: int) -> np.ndarray:
+        g = self._gbdt
+        ss = g.train if data_idx == 0 else g.valids[data_idx - 1]
+        score = g.get_score(ss)
+        return score if g.num_class > 1 else score[0]
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        raise NotImplementedError("use eval_train/eval_valid")
+
+    def eval_train(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        out = self._gbdt.eval_train()
+        out = [(self._train_data_name, n, v, hb) for (_dn, n, v, hb) in out]
+        if feval is not None:
+            out.extend(self._run_feval(feval, 0, self._train_data_name))
+        return out
+
+    def eval_valid(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        out = self._gbdt.eval_valid()
+        if feval is not None:
+            for i, name in enumerate(self._name_valid_sets):
+                out.extend(self._run_feval(feval, i + 1, name))
+        return out
+
+    def _run_feval(self, feval, data_idx: int, name: str):
+        ds = self.train_set if data_idx == 0 else self._valid_sets[data_idx - 1]
+        preds = self.__inner_predict_raw(data_idx)
+        res = feval(preds, ds)
+        if isinstance(res, list):
+            results = res
+        else:
+            results = [res]
+        return [(name, rn, rv, rhb) for rn, rv, rhb in results]
+
+    # ------------------------------------------------------------------
+    def predict(
+        self,
+        data: Any,
+        start_iteration: int = 0,
+        num_iteration: Optional[int] = None,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        pred_contrib: bool = False,
+        validate_features: bool = False,
+        **kwargs: Any,
+    ) -> np.ndarray:
+        arr, _ = _to_2d_numpy(data)
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(arr, start_iteration, num_iteration)
+        if pred_contrib:
+            raise NotImplementedError("pred_contrib (SHAP) is a later milestone")
+        return self._gbdt.predict(arr, start_iteration, num_iteration, raw_score=raw_score)
+
+    # ------------------------------------------------------------------
+    def model_to_string(
+        self, num_iteration: Optional[int] = None, start_iteration: int = 0,
+        importance_type: str = "split",
+    ) -> str:
+        from .model_io import save_model_string
+
+        ni = num_iteration
+        if ni is None:
+            ni = self.best_iteration if self.best_iteration > 0 else -1
+        return save_model_string(self._gbdt, self.config, ni, start_iteration)
+
+    def save_model(
+        self, filename: Union[str, Path], num_iteration: Optional[int] = None,
+        start_iteration: int = 0, importance_type: str = "split",
+    ) -> "Booster":
+        Path(filename).write_text(
+            self.model_to_string(num_iteration, start_iteration, importance_type)
+        )
+        return self
+
+    def feature_importance(self, importance_type: str = "split", iteration=None) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type)
+
+    def feature_name(self) -> List[str]:
+        if self.train_set is not None:
+            return self.train_set.get_feature_name()
+        return list(self._gbdt.feature_names)
+
+    def num_feature(self) -> int:
+        if self._gbdt.train_set is not None:
+            return self._gbdt.train_set.num_total_features
+        return len(self._gbdt.feature_names)
+
+    def free_dataset(self) -> "Booster":
+        self.train_set = None
+        return self
+
+    def free_network(self) -> "Booster":
+        self._network_initialized = False
+        return self

@@ -1,0 +1,347 @@
+"""Host-side feature binning (BinMapper).
+
+Replicates the behavior of the reference binning front-end
+(include/LightGBM/bin.h:85-259 BinMapper, src/io/bin.cpp GreedyFindBin /
+FindBin): per-feature value->bin mapping with at most `max_bin` bins built
+from sampled values, zero-as-one-bin splitting, missing-value handling
+(None / Zero / NaN, bin.h:27), and categorical bins ordered by count.
+
+Binning runs on host (numpy) once per dataset; the resulting bin matrix is
+what lives on TPU. This mirrors the reference where binning is a CPU
+preprocessing step even for the CUDA backend.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# reference: include/LightGBM/bin.h kZeroThreshold
+K_ZERO_THRESHOLD = 1e-35
+K_SPARSE_THRESHOLD = 0.8
+K_MISSING_ZERO = -1  # placeholder
+
+
+class MissingType(enum.IntEnum):
+    # reference bin.h:27 enum MissingType
+    NONE = 0
+    ZERO = 1
+    NAN = 2
+
+
+class BinType(enum.IntEnum):
+    # reference bin.h BinType
+    NUMERICAL = 0
+    CATEGORICAL = 1
+
+
+def greedy_find_bin(
+    distinct_values: np.ndarray,
+    counts: np.ndarray,
+    max_bin: int,
+    total_cnt: int,
+    min_data_in_bin: int,
+) -> List[float]:
+    """Build <=max_bin upper bounds over sorted distinct values.
+
+    Mirrors src/io/bin.cpp GreedyFindBin: small-cardinality features get one
+    bin per distinct value (merging ones below min_data_in_bin); otherwise a
+    greedy equal-mass packing where any value holding >= mean bin mass gets
+    its own bin.
+    """
+    num_distinct = len(distinct_values)
+    upper_bounds: List[float] = []
+    if num_distinct == 0:
+        return [float("inf")]
+    if num_distinct <= max_bin:
+        cur_cnt_inbin = 0
+        for i in range(num_distinct - 1):
+            cur_cnt_inbin += int(counts[i])
+            if cur_cnt_inbin >= min_data_in_bin:
+                upper_bounds.append(
+                    (float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0
+                )
+                cur_cnt_inbin = 0
+        upper_bounds.append(float("inf"))
+        return upper_bounds
+
+    max_bin = min(max_bin, max(1, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(np.sum(is_big))
+    rest_sample_cnt = total_cnt - int(np.sum(counts[is_big]))
+    if rest_bin_cnt > 0:
+        mean_bin_size = rest_sample_cnt / rest_bin_cnt
+    bin_cnt = 0
+    lower_bounds_open = True
+    cur_cnt_inbin = 0
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur_cnt_inbin += int(counts[i])
+        # need a new bin: current value is big, accumulated enough mass, or
+        # next value is big and we have at least min_data_in_bin
+        if (
+            is_big[i]
+            or cur_cnt_inbin >= mean_bin_size
+            or (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))
+        ):
+            upper_bounds.append(
+                (float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0
+            )
+            bin_cnt += 1
+            cur_cnt_inbin = 0
+            if bin_cnt >= max_bin - 1:
+                break
+            if not is_big[i] and rest_bin_cnt > bin_cnt:
+                mean_bin_size = rest_sample_cnt / (rest_bin_cnt - bin_cnt)
+    upper_bounds.append(float("inf"))
+    return upper_bounds
+
+
+def find_bin_bounds(
+    values: np.ndarray,
+    total_sample_cnt: int,
+    max_bin: int,
+    min_data_in_bin: int,
+    zero_as_one_bin: bool = True,
+) -> List[float]:
+    """FindBin semantics (src/io/bin.cpp BinMapper::FindBin numerical path).
+
+    `values` are the sampled *non-missing* values; zeros that were omitted
+    from sampling are accounted via total_sample_cnt - len(values) (the
+    reference samples only non-zero values and infers the zero count).
+    Zero gets its own bin: the value range is split at +-kZeroThreshold and
+    bins are found separately on the negative and positive parts.
+    """
+    values = np.asarray(values, dtype=np.float64)
+    zero_cnt = int(total_sample_cnt - len(values))
+    neg = values[values < -K_ZERO_THRESHOLD]
+    pos = values[values > K_ZERO_THRESHOLD]
+    zero_cnt += int(len(values) - len(neg) - len(pos))
+
+    if not zero_as_one_bin:
+        dv, cnt = np.unique(values, return_counts=True)
+        return greedy_find_bin(dv, cnt, max_bin, total_sample_cnt, min_data_in_bin)
+
+    bounds: List[float] = []
+    # budget split proportional to counts on each side (reference :165-186)
+    left_cnt = len(neg)
+    right_cnt = len(pos)
+    non_zero = left_cnt + right_cnt
+    if non_zero == 0:
+        return [float("inf")]
+    left_max_bin = max(1, int((max_bin - 1) * left_cnt / max(1, non_zero + zero_cnt)))
+    if left_cnt > 0:
+        dv, cnt = np.unique(neg, return_counts=True)
+        bounds.extend(greedy_find_bin(dv, cnt, left_max_bin, left_cnt, min_data_in_bin))
+        # the last bound of the negative side closes at -kZeroThreshold
+        bounds[-1] = -K_ZERO_THRESHOLD
+    if zero_cnt > 0 or (left_cnt > 0 and right_cnt > 0):
+        bounds.append(K_ZERO_THRESHOLD)  # the zero bin
+    if right_cnt > 0:
+        right_max_bin = max_bin - 1 - len(bounds)
+        right_max_bin = max(1, right_max_bin)
+        dv, cnt = np.unique(pos, return_counts=True)
+        bounds.extend(
+            greedy_find_bin(dv, cnt, right_max_bin, right_cnt, min_data_in_bin)
+        )
+    else:
+        bounds.append(float("inf"))
+    # dedupe & sort defensively
+    out = sorted(set(bounds))
+    if out[-1] != float("inf"):
+        out.append(float("inf"))
+    return out
+
+
+@dataclass
+class BinMapper:
+    """Per-feature value->bin mapping (reference bin.h:85)."""
+
+    upper_bounds: np.ndarray = field(default_factory=lambda: np.array([np.inf]))
+    bin_type: BinType = BinType.NUMERICAL
+    missing_type: MissingType = MissingType.NONE
+    categories: Tuple[int, ...] = ()  # bin index -> category value
+    num_bin: int = 1
+    most_freq_bin: int = 0
+    default_bin: int = 0  # bin of value 0.0 (GetDefaultBin)
+    is_trivial: bool = True  # single bin -> feature unused
+    min_value: float = 0.0
+    max_value: float = 0.0
+    _cat_to_bin: Optional[Dict[int, int]] = None
+
+    @staticmethod
+    def from_sample(
+        values: np.ndarray,
+        total_sample_cnt: int,
+        max_bin: int,
+        min_data_in_bin: int = 3,
+        use_missing: bool = True,
+        zero_as_missing: bool = False,
+        bin_type: BinType = BinType.NUMERICAL,
+        min_data_per_group: int = 100,
+        max_cat_threshold: int = 32,
+    ) -> "BinMapper":
+        values = np.asarray(values, dtype=np.float64).ravel()
+        na_cnt = int(np.sum(np.isnan(values)))
+        clean = values[~np.isnan(values)]
+
+        if bin_type == BinType.CATEGORICAL:
+            return BinMapper._categorical(
+                clean, na_cnt, total_sample_cnt, max_bin, use_missing
+            )
+
+        # missing type resolution (reference FindBin :120-160)
+        if not use_missing:
+            missing_type = MissingType.NONE
+        elif zero_as_missing:
+            missing_type = MissingType.ZERO
+        elif na_cnt > 0:
+            missing_type = MissingType.NAN
+        else:
+            missing_type = MissingType.NONE
+
+        if missing_type == MissingType.NAN:
+            eff_max_bin = max_bin - 1  # reserve last bin for NaN
+        else:
+            eff_max_bin = max_bin
+            if missing_type == MissingType.NONE and na_cnt > 0:
+                # NaNs treated as zero when use_missing=false
+                clean = np.concatenate([clean, np.zeros(na_cnt)])
+                na_cnt = 0
+
+        bounds = find_bin_bounds(
+            clean,
+            total_sample_cnt - (na_cnt if missing_type == MissingType.NAN else 0),
+            eff_max_bin,
+            min_data_in_bin,
+        )
+        ub = np.asarray(bounds, dtype=np.float64)
+        num_bin = len(ub)
+        if missing_type == MissingType.NAN:
+            num_bin += 1  # trailing NaN bin
+
+        m = BinMapper(
+            upper_bounds=ub,
+            bin_type=BinType.NUMERICAL,
+            missing_type=missing_type,
+            num_bin=num_bin,
+            is_trivial=(num_bin <= 1),
+            min_value=float(np.min(clean)) if len(clean) else 0.0,
+            max_value=float(np.max(clean)) if len(clean) else 0.0,
+        )
+        m.default_bin = int(np.searchsorted(ub, 0.0, side="left"))
+        # most_freq_bin from the sample histogram
+        if len(clean):
+            sample_bins = m.values_to_bins(clean)
+            zero_extra = total_sample_cnt - len(clean) - na_cnt
+            bc = np.bincount(sample_bins, minlength=m.num_bin).astype(np.int64)
+            if zero_extra > 0:
+                bc[m.default_bin] += zero_extra
+            m.most_freq_bin = int(np.argmax(bc))
+        return m
+
+    @staticmethod
+    def _categorical(
+        clean: np.ndarray,
+        na_cnt: int,
+        total_sample_cnt: int,
+        max_bin: int,
+        use_missing: bool,
+    ) -> "BinMapper":
+        # reference FindBin categorical path: categories sorted by count desc,
+        # keep up to max_bin-1 (cut categories covering <0.1% at the tail),
+        # bin 0 holds the most frequent category; negative values -> NaN-ish.
+        ints = clean.astype(np.int64)
+        neg_mask = ints < 0
+        if np.any(neg_mask):
+            na_cnt += int(np.sum(neg_mask))
+            ints = ints[~neg_mask]
+        cats, cnts = np.unique(ints, return_counts=True)
+        order = np.argsort(-cnts, kind="stable")
+        cats, cnts = cats[order], cnts[order]
+        keep = min(len(cats), max_bin - 1 if (use_missing and na_cnt > 0) else max_bin)
+        # drop ultra-rare tail categories (reference cuts cumulative 99% + cnt>=2 logic simplified)
+        cats, cnts = cats[:keep], cnts[:keep]
+        missing_type = MissingType.NAN if (use_missing and na_cnt > 0) else MissingType.NONE
+        num_bin = len(cats) + (1 if missing_type == MissingType.NAN else 0)
+        m = BinMapper(
+            upper_bounds=np.array([np.inf]),
+            bin_type=BinType.CATEGORICAL,
+            missing_type=missing_type,
+            categories=tuple(int(c) for c in cats),
+            num_bin=max(1, num_bin),
+            is_trivial=(num_bin <= 1),
+            min_value=float(cats.min()) if len(cats) else 0.0,
+            max_value=float(cats.max()) if len(cats) else 0.0,
+        )
+        m._cat_to_bin = {int(c): i for i, c in enumerate(cats)}
+        m.most_freq_bin = 0
+        m.default_bin = m._cat_to_bin.get(0, 0)
+        return m
+
+    # ---- value -> bin ----
+    def values_to_bins(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized ValueToBin (reference bin.h:161)."""
+        values = np.asarray(values, dtype=np.float64).ravel()
+        if self.bin_type == BinType.CATEGORICAL:
+            out = np.zeros(len(values), dtype=np.int32)
+            nan_bin = self.num_bin - 1 if self.missing_type == MissingType.NAN else 0
+            c2b = self._cat_to_bin or {}
+            ints = np.where(np.isnan(values), -1, values).astype(np.int64)
+            # vectorized dict lookup
+            if c2b:
+                keys = np.fromiter(c2b.keys(), dtype=np.int64)
+                vals = np.fromiter(c2b.values(), dtype=np.int32)
+                sorter = np.argsort(keys)
+                keys, vals = keys[sorter], vals[sorter]
+                idx = np.searchsorted(keys, ints)
+                idx = np.clip(idx, 0, len(keys) - 1)
+                found = keys[idx] == ints
+                out = np.where(found, vals[idx], nan_bin).astype(np.int32)
+            out[ints < 0] = nan_bin
+            return out
+        nan_mask = np.isnan(values)
+        vv = np.where(nan_mask, 0.0, values)
+        bins = np.searchsorted(self.upper_bounds, vv, side="left").astype(np.int32)
+        n_numeric_bins = len(self.upper_bounds)
+        bins = np.clip(bins, 0, n_numeric_bins - 1)
+        if self.missing_type == MissingType.NAN:
+            bins[nan_mask] = self.num_bin - 1
+        elif self.missing_type == MissingType.ZERO:
+            bins[nan_mask] = self.default_bin
+        else:
+            bins[nan_mask] = self.default_bin
+        return bins
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Threshold bin -> real split value (BinToValue; model files store
+        real thresholds and predict with `value <= threshold`)."""
+        if self.bin_type == BinType.CATEGORICAL:
+            if 0 <= bin_idx < len(self.categories):
+                return float(self.categories[bin_idx])
+            return float("nan")
+        n = len(self.upper_bounds)
+        b = min(int(bin_idx), n - 1)
+        ub = float(self.upper_bounds[b])
+        if np.isinf(ub) and ub > 0:
+            return float(self.max_value)
+        return ub
+
+    @property
+    def nan_bin(self) -> int:
+        return self.num_bin - 1 if self.missing_type == MissingType.NAN else -1
+
+    def feature_info_str(self) -> str:
+        """feature_infos entry for the text model format
+        (gbdt_model_text.cpp: `[min:max]` numerical, `cat:cat:...` categorical,
+        `none` for trivial)."""
+        if self.is_trivial:
+            return "none"
+        if self.bin_type == BinType.CATEGORICAL:
+            return ":".join(str(c) for c in self.categories)
+        return f"[{self.min_value:g}:{self.max_value:g}]"

@@ -1,0 +1,403 @@
+"""GBDT boosting driver (reference src/boosting/gbdt.cpp).
+
+Owns the training loop state: per-dataset device scores, the objective,
+the sampling strategy, and the growing list of trees. Each iteration:
+
+  gradients (device, objective)  ->  sampling mask (bagging/GOSS)
+  ->  grow_tree (jit; one call per class-tree)  ->  leaf renewal for
+  percentile objectives (RenewTreeOutput, objective_function.h:55)
+  ->  score updates: train via the partition vector
+  (score_updater.hpp AddScore fast path), valid via device tree
+  traversal  ->  host Tree for the model list.
+
+Boost-from-average follows gbdt.cpp:327-445: the initial score is added
+to all scorers before the first iteration and folded into the first
+tree's leaf values afterwards (Tree::AddBias), so saved models are
+self-contained.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import log
+from .config import Config
+from .dataset import BinnedDataset
+from .learner import GrowerSpec, grow_tree, make_split_params
+from .learner.grower import TreeArrays, add_score
+from .metrics import Metric, create_metrics
+from .objectives import ObjectiveFunction, create_objective
+from .sample_strategy import create_sample_strategy
+from .tree import Tree, traverse_tree_bins
+
+
+@dataclass
+class _ScoreSet:
+    dataset: BinnedDataset
+    score: Any  # (K, Npad) device f32
+    name: str
+    metrics: List[Metric] = field(default_factory=list)
+
+
+def _jit_traverse():
+    import jax
+
+    return jax.jit(traverse_tree_bins)
+
+
+class GBDT:
+    """Training driver (reference gbdt.h:37)."""
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset]):
+        import jax.numpy as jnp
+
+        self.config = config
+        self.train_set = train_set
+        self.objective: Optional[ObjectiveFunction] = create_objective(config)
+        self.num_class = config.num_model_per_iteration
+        self.shrinkage_rate = config.learning_rate
+        self.models: List[Tree] = []  # flat, iteration-major (models_[it*K + k])
+        self.device_trees: List[Tuple[TreeArrays, Any]] = []  # (arrays w/ final leaf values, None)
+        self.iter_ = 0
+        self.best_iteration = -1
+        self.valids: List[_ScoreSet] = []
+        self._traverse = _jit_traverse()
+
+        if train_set is None:
+            return  # prediction-only booster (model loaded from file)
+
+        if self.objective is not None:
+            self.objective.init(train_set)
+        self.strategy = create_sample_strategy(config, train_set.num_data)
+        self.dev = train_set.device_arrays()
+        self.spec = GrowerSpec(
+            num_leaves=config.num_leaves,
+            num_bins=train_set.max_num_bin,
+            max_depth=config.max_depth,
+            axis_name=None,
+        )
+        self.params = make_split_params(config)
+        self.train = _ScoreSet(
+            train_set,
+            self._init_score_arr(train_set),
+            "training",
+            [m for m in create_metrics(config)],
+        )
+        meta = train_set.metadata
+        for m in self.train.metrics:
+            m.init(meta.label, meta.weight, meta.group)
+        self._boosted_from_average = False
+        self._init_scores = [0.0] * self.num_class
+        self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
+        self._label_dev = (
+            jnp.asarray(train_set.padded(meta.label)) if meta.label is not None else None
+        )
+
+    # ------------------------------------------------------------------
+    def _init_score_arr(self, ds: BinnedDataset):
+        import jax.numpy as jnp
+
+        npad = ds.num_rows_padded()
+        score = np.zeros((self.num_class, npad), dtype=np.float32)
+        init = ds.metadata.init_score
+        if init is not None:
+            init = np.asarray(init, dtype=np.float32)
+            if init.size == ds.num_data * self.num_class:
+                score[:, : ds.num_data] = init.reshape(self.num_class, ds.num_data)
+            else:
+                score[:, : ds.num_data] = init[None, :]
+        return jnp.asarray(score)
+
+    def add_valid(self, valid_set: BinnedDataset, name: str) -> None:
+        ss = _ScoreSet(
+            valid_set,
+            self._init_score_arr(valid_set),
+            name,
+            [m for m in create_metrics(self.config)],
+        )
+        meta = valid_set.metadata
+        for m in ss.metrics:
+            m.init(meta.label, meta.weight, meta.group)
+        self.valids.append(ss)
+
+    @property
+    def has_init_score(self) -> bool:
+        return self.train_set.metadata.init_score is not None
+
+    # ------------------------------------------------------------------
+    def train_one_iter(
+        self, grad: Optional[np.ndarray] = None, hess: Optional[np.ndarray] = None
+    ) -> bool:
+        """One boosting iteration; returns True when training should stop
+        (no splittable leaf), matching GBDT::TrainOneIter (gbdt.cpp:352)."""
+        import jax.numpy as jnp
+
+        K = self.num_class
+        ds = self.train_set
+        init_scores = [0.0] * K
+
+        if grad is None or hess is None:
+            if self.objective is None:
+                log.fatal("custom objective requires explicit grad/hess")
+            # boost from average (first iteration only)
+            if (
+                not self.models
+                and self.config.boost_from_average
+                and not self.has_init_score
+            ):
+                for k in range(K):
+                    init = self.objective.boost_from_score(k)
+                    if abs(init) > 1e-15:
+                        init_scores[k] = init
+                        self.train.score = self.train.score.at[k].add(init)
+                        for vs in self.valids:
+                            vs.score = vs.score.at[k].add(init)
+                        log.info(f"Start training from score {init:f}")
+            score = self.train.score if K > 1 else self.train.score[0]
+            g, h = self.objective.get_gradients(score)
+            grad_dev = jnp.reshape(g, (K, -1)).astype(jnp.float32)
+            hess_dev = jnp.reshape(h, (K, -1)).astype(jnp.float32)
+        else:
+            grad = np.asarray(grad, dtype=np.float32).reshape(K, ds.num_data)
+            hess = np.asarray(hess, dtype=np.float32).reshape(K, ds.num_data)
+            npad = ds.num_rows_padded()
+            gp = np.zeros((K, npad), np.float32)
+            hp = np.zeros((K, npad), np.float32)
+            gp[:, : ds.num_data] = grad
+            hp[:, : ds.num_data] = hess
+            grad_dev, hess_dev = jnp.asarray(gp), jnp.asarray(hp)
+
+        should_continue = False
+        for k in range(K):
+            gk, hk = grad_dev[k], hess_dev[k]
+            mask, gk, hk = self.strategy.sample(
+                self.iter_, gk, hk, self.dev["valid"], self._label_dev
+            )
+            feat_mask = self._sample_features()
+            arrays, row_leaf = grow_tree(
+                self.dev["bins"],
+                self.dev["nan_bin"],
+                self.dev["num_bins"],
+                self.dev["mono"],
+                self.dev["is_cat"],
+                gk,
+                hk,
+                mask,
+                feat_mask,
+                self.params,
+                self.spec,
+            )
+            n_nodes = int(arrays.num_nodes)
+            if n_nodes > 0:
+                should_continue = True
+                if (
+                    self.objective is not None
+                    and self.objective.is_renew_tree_output
+                ):
+                    arrays = self._renew_tree_output(arrays, row_leaf, k, mask)
+                # host tree applies shrinkage itself; device copy carries
+                # the final (shrunk) leaf values for score updates
+                tree = Tree.from_arrays(arrays, ds, self.shrinkage_rate)
+                final_leaf = arrays.leaf_value * self.shrinkage_rate
+                arrays = arrays._replace(leaf_value=final_leaf)
+                one = jnp.float32(1.0)
+                self.train.score = self.train.score.at[k].set(
+                    add_score(self.train.score[k], row_leaf, final_leaf, one)
+                )
+                for vs in self.valids:
+                    vdev = vs.dataset.device_arrays()
+                    leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                    vs.score = vs.score.at[k].set(
+                        add_score(vs.score[k], leaf, final_leaf, one)
+                    )
+                if abs(init_scores[k]) > 1e-15:
+                    tree.leaf_value = tree.leaf_value + init_scores[k]  # AddBias
+                self.device_trees.append((arrays, None))
+                self.models.append(tree)
+            else:
+                # stump: constant tree (gbdt.cpp:429-441)
+                bias = 0.0
+                if len(self.models) < K:
+                    if (
+                        self.objective is not None
+                        and not self.config.boost_from_average
+                        and not self.has_init_score
+                    ):
+                        bias = self.objective.boost_from_score(k)
+                        self.train.score = self.train.score.at[k].add(bias)
+                        for vs in self.valids:
+                            vs.score = vs.score.at[k].add(bias)
+                    else:
+                        bias = init_scores[k]
+                t = Tree(num_leaves=1, shrinkage=1.0)
+                t.leaf_value = np.array([bias], np.float64)
+                self.models.append(t)
+                self.device_trees.append((arrays, None))
+
+        if not should_continue:
+            log.warning(
+                "Stopped training because there are no more leaves that meet the split requirements"
+            )
+            if len(self.models) > K:
+                for _ in range(K):
+                    self.models.pop()
+                    self.device_trees.pop()
+            return True
+        self.iter_ += 1
+        return False
+
+    # ------------------------------------------------------------------
+    def _sample_features(self):
+        import jax.numpy as jnp
+
+        F = self.train_set.num_used_features
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return jnp.ones(F, dtype=bool)
+        n = max(1, int(np.ceil(frac * F)))
+        chosen = self._feat_rng.choice(F, n, replace=False)
+        m = np.zeros(F, dtype=bool)
+        m[chosen] = True
+        return jnp.asarray(m)
+
+    def _renew_tree_output(self, arrays: TreeArrays, row_leaf, k: int, mask) -> TreeArrays:
+        """Percentile leaf refit for l1/huber/quantile/mape
+        (RegressionL1loss::RenewTreeOutput)."""
+        import jax.numpy as jnp
+
+        ds = self.train_set
+        n = ds.num_data
+        rl = np.asarray(row_leaf)[:n]
+        bag = np.asarray(mask)[:n] > 0
+        label = np.asarray(ds.metadata.label, dtype=np.float64)
+        score = np.asarray(self.train.score[k])[:n].astype(np.float64)
+        resid = label - score
+        w = (
+            np.asarray(ds.metadata.weight, dtype=np.float64)
+            if ds.metadata.weight is not None
+            else np.ones(n)
+        )
+        if hasattr(self.objective, "_label_weight"):  # mape
+            w = np.asarray(self.objective._label_weight)[:n].astype(np.float64)
+        alpha = self.objective.renew_percentile()
+        lv = np.asarray(arrays.leaf_value).copy()
+        n_leaves = int(arrays.num_nodes) + 1
+        for leaf in range(n_leaves):
+            sel = (rl == leaf) & bag
+            if not np.any(sel):
+                continue
+            r, ww = resid[sel], w[sel]
+            order = np.argsort(r)
+            cw = np.cumsum(ww[order])
+            t = alpha * cw[-1]
+            idx = min(int(np.searchsorted(cw, t)), len(r) - 1)
+            lv[leaf] = r[order][idx]
+        return arrays._replace(leaf_value=jnp.asarray(lv))
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """GBDT::RollbackOneIter (gbdt.cpp:462)."""
+        if self.iter_ <= 0:
+            return
+        K = self.num_class
+        for k in reversed(range(K)):
+            tree = self.models.pop()
+            arrays, _ = self.device_trees.pop()
+            if tree.num_leaves > 1:
+                leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"])
+                self.train.score = self.train.score.at[k].add(-arrays.leaf_value[leaf])
+                for vs in self.valids:
+                    vdev = vs.dataset.device_arrays()
+                    vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                    vs.score = vs.score.at[k].add(-arrays.leaf_value[vleaf])
+            else:
+                # stump: its constant (boost-from-score bias) was added to
+                # the scores directly — remove it too
+                bias = float(tree.leaf_value[0])
+                if abs(bias) > 1e-15:
+                    self.train.score = self.train.score.at[k].add(-bias)
+                    for vs in self.valids:
+                        vs.score = vs.score.at[k].add(-bias)
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    def eval_set(self, ss: _ScoreSet) -> List[Tuple[str, str, float, bool]]:
+        n = ss.dataset.num_data
+        score = np.asarray(ss.score)[:, :n].astype(np.float64)
+        s = score if self.num_class > 1 else score[0]
+        out = []
+        for m in ss.metrics:
+            for name, val, hb in m.eval(s):
+                out.append((ss.name, name, val, hb))
+        return out
+
+    def eval_train(self):
+        return self.eval_set(self.train)
+
+    def eval_valid(self):
+        out = []
+        for vs in self.valids:
+            out.extend(self.eval_set(vs))
+        return out
+
+    def get_score(self, ss: _ScoreSet) -> np.ndarray:
+        n = ss.dataset.num_data
+        return np.asarray(ss.score)[:, :n].astype(np.float64)
+
+    # ------------------------------------------------------------------
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def current_iteration(self) -> int:
+        return self.iter_
+
+    def predict_raw(
+        self,
+        X: np.ndarray,
+        start_iteration: int = 0,
+        num_iteration: int = -1,
+    ) -> np.ndarray:
+        """Raw margin prediction over host trees (gbdt_prediction.cpp)."""
+        X = np.asarray(X, dtype=np.float64)
+        K = self.num_class
+        n_iters = len(self.models) // K
+        end = n_iters if num_iteration <= 0 else min(n_iters, start_iteration + num_iteration)
+        out = np.zeros((K, X.shape[0]))
+        for it in range(start_iteration, end):
+            for k in range(K):
+                out[k] += self.models[it * K + k].predict(X)
+        return out
+
+    def predict(self, X, start_iteration=0, num_iteration=-1, raw_score=False):
+        raw = self.predict_raw(X, start_iteration, num_iteration)
+        if not raw_score and self.objective is not None:
+            raw = self.objective.convert_output(raw)
+        if self.num_class == 1:
+            return raw[0]
+        return raw.T  # (N, K)
+
+    def predict_leaf_index(self, X, start_iteration=0, num_iteration=-1):
+        X = np.asarray(X, dtype=np.float64)
+        K = self.num_class
+        n_iters = len(self.models) // K
+        end = n_iters if num_iteration <= 0 else min(n_iters, start_iteration + num_iteration)
+        cols = []
+        for it in range(start_iteration, end):
+            for k in range(K):
+                cols.append(self.models[it * K + k].predict_leaf(X))
+        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0), np.int64)
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        nf = self.train_set.num_total_features if self.train_set else (
+            max((int(np.max(t.split_feature)) for t in self.models if len(t.split_feature)), default=-1) + 1
+        )
+        imp = np.zeros(nf)
+        for t in self.models:
+            if importance_type == "gain":
+                imp += t.feature_importance_gain(nf)
+            else:
+                imp += t.feature_importance_split(nf)
+        return imp

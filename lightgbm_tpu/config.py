@@ -1,0 +1,375 @@
+"""Parameter system: names, aliases, defaults, and validation.
+
+The reference keeps ~180 parameters as annotated fields of a single Config
+struct (include/LightGBM/config.h:40-1324) and generates the alias table and
+k=v parser from the annotations (src/io/config_auto.cpp, src/io/config.cpp).
+Here the same information is data-driven: `_PARAMS` is the schema, `Config`
+resolves aliases (ParameterAlias::KeyAliasTransform equivalent), coerces
+types, applies constraint checks, and keeps unknown keys as pass-through
+(the reference warns on unknown parameters).
+
+Parameter names and aliases are replicated verbatim so that reference-style
+param dicts (`lgb.train(params, ...)`) work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from . import log
+
+# name -> (default, type, aliases, check)
+# type is one of: bool, int, float, str, "list_int", "list_float", "list_str"
+# check is a predicate on the coerced value (None = no check).
+_P = Tuple[Any, Any, Tuple[str, ...], Optional[Callable[[Any], bool]]]
+
+_pos = lambda v: v > 0
+_nonneg = lambda v: v >= 0
+_frac = lambda v: 0.0 < v <= 1.0
+
+_PARAMS: Dict[str, _P] = {
+    # ---- Core parameters (config.h "Core Parameters") ----
+    "config": ("", str, ("config_file",), None),
+    "task": ("train", str, ("task_type",), None),
+    "objective": ("regression", str, ("objective_type", "app", "application", "loss"), None),
+    "boosting": ("gbdt", str, ("boosting_type", "boost"), None),
+    "data_sample_strategy": ("bagging", str, (), None),
+    "data": ("", str, ("train", "train_data", "train_data_file", "data_filename"), None),
+    "valid": ("", "list_str", ("test", "valid_data", "valid_data_file", "test_data", "test_data_file", "valid_filenames"), None),
+    "num_iterations": (100, int, ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round", "num_rounds", "nrounds", "num_boost_round", "n_estimators", "max_iter"), _nonneg),
+    "learning_rate": (0.1, float, ("shrinkage_rate", "eta"), _pos),
+    "num_leaves": (31, int, ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes"), lambda v: 1 < v <= 131072),
+    "tree_learner": ("serial", str, ("tree", "tree_type", "tree_learner_type"), None),
+    "num_threads": (0, int, ("num_thread", "nthread", "nthreads", "n_jobs"), None),
+    "device_type": ("tpu", str, ("device",), None),
+    "seed": (None, int, ("random_seed", "random_state"), None),
+    "deterministic": (False, bool, (), None),
+    # ---- Learning control ----
+    "force_col_wise": (False, bool, (), None),
+    "force_row_wise": (False, bool, (), None),
+    "histogram_pool_size": (-1.0, float, ("hist_pool_size",), None),
+    "max_depth": (-1, int, (), None),
+    "min_data_in_leaf": (20, int, ("min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf"), _nonneg),
+    "min_sum_hessian_in_leaf": (1e-3, float, ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian", "min_child_weight"), _nonneg),
+    "bagging_fraction": (1.0, float, ("sub_row", "subsample", "bagging"), _frac),
+    "pos_bagging_fraction": (1.0, float, ("pos_sub_row", "pos_subsample", "pos_bagging"), _frac),
+    "neg_bagging_fraction": (1.0, float, ("neg_sub_row", "neg_subsample", "neg_bagging"), _frac),
+    "bagging_freq": (0, int, ("subsample_freq",), None),
+    "bagging_seed": (3, int, ("bagging_fraction_seed",), None),
+    "bagging_by_query": (False, bool, (), None),
+    "feature_fraction": (1.0, float, ("sub_feature", "colsample_bytree"), _frac),
+    "feature_fraction_bynode": (1.0, float, ("sub_feature_bynode", "colsample_bynode"), _frac),
+    "feature_fraction_seed": (2, int, (), None),
+    "extra_trees": (False, bool, ("extra_tree",), None),
+    "extra_seed": (6, int, (), None),
+    "early_stopping_round": (0, int, ("early_stopping_rounds", "early_stopping", "n_iter_no_change"), None),
+    "early_stopping_min_delta": (0.0, float, (), _nonneg),
+    "first_metric_only": (False, bool, (), None),
+    "max_delta_step": (0.0, float, ("max_tree_output", "max_leaf_output"), None),
+    "lambda_l1": (0.0, float, ("reg_alpha", "l1_regularization"), _nonneg),
+    "lambda_l2": (0.0, float, ("reg_lambda", "lambda", "l2_regularization"), _nonneg),
+    "linear_lambda": (0.0, float, (), _nonneg),
+    "min_gain_to_split": (0.0, float, ("min_split_gain",), _nonneg),
+    "drop_rate": (0.1, float, ("rate_drop",), lambda v: 0.0 <= v <= 1.0),
+    "max_drop": (50, int, (), None),
+    "skip_drop": (0.5, float, (), lambda v: 0.0 <= v <= 1.0),
+    "xgboost_dart_mode": (False, bool, (), None),
+    "uniform_drop": (False, bool, (), None),
+    "drop_seed": (4, int, (), None),
+    "top_rate": (0.2, float, (), lambda v: 0.0 <= v <= 1.0),
+    "other_rate": (0.1, float, (), lambda v: 0.0 <= v <= 1.0),
+    "min_data_per_group": (100, int, (), _pos),
+    "max_cat_threshold": (32, int, (), _pos),
+    "cat_l2": (10.0, float, (), _nonneg),
+    "cat_smooth": (10.0, float, (), _nonneg),
+    "max_cat_to_onehot": (4, int, (), _pos),
+    "top_k": (20, int, ("topk",), _pos),
+    "monotone_constraints": ((), "list_int", ("mc", "monotone_constraint", "monotonic_cst"), None),
+    "monotone_constraints_method": ("basic", str, ("monotone_constraining_method", "mc_method"), None),
+    "monotone_penalty": (0.0, float, ("monotone_splits_penalty", "ms_penalty", "mc_penalty"), _nonneg),
+    "feature_contri": ((), "list_float", ("feature_contrib", "fc", "fp", "feature_penalty"), None),
+    "forcedsplits_filename": ("", str, ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits"), None),
+    "refit_decay_rate": (0.9, float, (), lambda v: 0.0 <= v <= 1.0),
+    "cegb_tradeoff": (1.0, float, (), _nonneg),
+    "cegb_penalty_split": (0.0, float, (), _nonneg),
+    "cegb_penalty_feature_lazy": ((), "list_float", (), None),
+    "cegb_penalty_feature_coupled": ((), "list_float", (), None),
+    "path_smooth": (0.0, float, (), _nonneg),
+    "interaction_constraints": ("", str, (), None),
+    "verbosity": (1, int, ("verbose",), None),
+    "use_quantized_grad": (False, bool, (), None),
+    "num_grad_quant_bins": (4, int, (), None),
+    "quant_train_renew_leaf": (False, bool, (), None),
+    "stochastic_rounding": (True, bool, (), None),
+    # ---- IO / dataset ----
+    "linear_tree": (False, bool, ("linear_trees",), None),
+    "max_bin": (255, int, ("max_bins",), lambda v: v > 1),
+    "max_bin_by_feature": ((), "list_int", (), None),
+    "min_data_in_bin": (3, int, (), _pos),
+    "bin_construct_sample_cnt": (200000, int, ("subsample_for_bin",), _pos),
+    "data_random_seed": (1, int, ("data_seed",), None),
+    "is_enable_sparse": (True, bool, ("is_sparse", "enable_sparse", "sparse"), None),
+    "enable_bundle": (True, bool, ("is_enable_bundle", "bundle"), None),
+    "use_missing": (True, bool, (), None),
+    "zero_as_missing": (False, bool, (), None),
+    "feature_pre_filter": (True, bool, (), None),
+    "pre_partition": (False, bool, ("is_pre_partition",), None),
+    "two_round": (False, bool, ("two_round_loading", "use_two_round_loading"), None),
+    "header": (False, bool, ("has_header",), None),
+    "label_column": ("", str, ("label",), None),
+    "weight_column": ("", str, ("weight",), None),
+    "group_column": ("", str, ("group", "group_id", "query_column", "query", "query_id"), None),
+    "ignore_column": ("", str, ("ignore_feature", "blacklist"), None),
+    "categorical_feature": ("", str, ("cat_feature", "categorical_column", "cat_column", "categorical_features"), None),
+    "forcedbins_filename": ("", str, (), None),
+    "save_binary": (False, bool, ("is_save_binary", "is_save_binary_file"), None),
+    "precise_float_parser": (False, bool, (), None),
+    "parser_config_file": ("", str, (), None),
+    # ---- Predict ----
+    "start_iteration_predict": (0, int, (), None),
+    "num_iteration_predict": (-1, int, (), None),
+    "predict_raw_score": (False, bool, ("is_predict_raw_score", "predict_rawscore", "raw_score"), None),
+    "predict_leaf_index": (False, bool, ("is_predict_leaf_index", "leaf_index"), None),
+    "predict_contrib": (False, bool, ("is_predict_contrib", "contrib"), None),
+    "predict_disable_shape_check": (False, bool, (), None),
+    "pred_early_stop": (False, bool, (), None),
+    "pred_early_stop_freq": (10, int, (), None),
+    "pred_early_stop_margin": (10.0, float, (), None),
+    "output_result": ("LightGBM_predict_result.txt", str, ("predict_result", "prediction_result", "predict_name", "pred_name", "name_pred"), None),
+    # ---- Convert/model ----
+    "convert_model_language": ("", str, (), None),
+    "convert_model": ("gbdt_prediction.cpp", str, ("convert_model_file",), None),
+    "input_model": ("", str, ("model_input", "model_in"), None),
+    "output_model": ("LightGBM_model.txt", str, ("model_output", "model_out"), None),
+    "saved_feature_importance_type": (0, int, (), None),
+    "snapshot_freq": (-1, int, ("save_period",), None),
+    # ---- Objective ----
+    "num_class": (1, int, ("num_classes",), _pos),
+    "is_unbalance": (False, bool, ("unbalance", "unbalanced_sets"), None),
+    "scale_pos_weight": (1.0, float, (), _pos),
+    "sigmoid": (1.0, float, (), _pos),
+    "boost_from_average": (True, bool, (), None),
+    "reg_sqrt": (False, bool, (), None),
+    "alpha": (0.9, float, (), _pos),
+    "fair_c": (1.0, float, (), _pos),
+    "poisson_max_delta_step": (0.7, float, (), _pos),
+    "tweedie_variance_power": (1.5, float, (), lambda v: 1.0 <= v < 2.0),
+    "lambdarank_truncation_level": (30, int, (), _pos),
+    "lambdarank_norm": (True, bool, (), None),
+    "label_gain": ((), "list_float", (), None),
+    "lambdarank_position_bias_regularization": (0.0, float, (), _nonneg),
+    "objective_seed": (5, int, (), None),
+    # ---- Metric ----
+    "metric": ((), "list_str", ("metrics", "metric_types"), None),
+    "metric_freq": (1, int, ("output_freq",), _pos),
+    "is_provide_training_metric": (False, bool, ("training_metric", "is_training_metric", "train_metric"), None),
+    "eval_at": ((1, 2, 3, 4, 5), "list_int", ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at"), None),
+    "multi_error_top_k": (1, int, (), _pos),
+    "auc_mu_weights": ((), "list_float", (), None),
+    # ---- Network (config.h "Network Parameters") ----
+    "num_machines": (1, int, ("num_machine",), _pos),
+    "local_listen_port": (12400, int, ("local_port", "port"), _pos),
+    "time_out": (120, int, (), _pos),
+    "machine_list_filename": ("", str, ("machine_list_file", "machine_list", "mlist"), None),
+    "machines": ("", str, ("workers", "nodes"), None),
+    # ---- GPU/device (accepted for compat; TPU build maps these onto the mesh) ----
+    "gpu_platform_id": (-1, int, (), None),
+    "gpu_device_id": (-1, int, (), None),
+    "gpu_use_dp": (False, bool, (), None),
+    "num_gpu": (1, int, (), _pos),
+    # ---- TPU-specific extensions (not in reference) ----
+    "tpu_row_block": (0, int, (), _nonneg),  # 0 = auto; rows per histogram matmul block
+    "tpu_hist_dtype": ("float32", str, (), None),
+    "tpu_mesh_axes": ("data", str, (), None),
+}
+
+# alias -> canonical name
+_ALIASES: Dict[str, str] = {}
+for _name, (_d, _t, _al, _c) in _PARAMS.items():
+    for _a in _al:
+        _ALIASES[_a] = _name
+
+_BOOL_TRUE = {"true", "1", "yes", "on", "t", "y", "+"}
+_BOOL_FALSE = {"false", "0", "no", "off", "f", "n", "-"}
+
+# objective name aliases (objective_function.cpp factory + config.h docs)
+OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+
+def _coerce(name: str, typ: Any, value: Any) -> Any:
+    if typ is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, (int, float)):
+            return bool(value)
+        s = str(value).strip().lower()
+        if s in _BOOL_TRUE:
+            return True
+        if s in _BOOL_FALSE:
+            return False
+        raise ValueError(f"cannot parse {value!r} as bool for parameter {name}")
+    if typ is int:
+        if value is None:
+            return None
+        return int(float(value)) if isinstance(value, str) else int(value)
+    if typ is float:
+        return float(value)
+    if typ is str:
+        return str(value).strip()
+    if typ in ("list_int", "list_float", "list_str"):
+        elem = {"list_int": int, "list_float": float, "list_str": str}[typ]
+        if isinstance(value, str):
+            value = [v for v in value.replace(";", ",").split(",") if v != ""]
+        if not isinstance(value, (list, tuple)):
+            value = [value]
+        return tuple(elem(v) for v in value)
+    raise AssertionError(f"unknown param type {typ}")
+
+
+def resolve_alias(key: str) -> str:
+    """ParameterAlias::KeyAliasTransform equivalent: alias -> canonical name."""
+    k = key.strip().lower()
+    return _ALIASES.get(k, k)
+
+
+def parse_kv_config(text: str) -> Dict[str, str]:
+    """Parse `k=v` lines (CLI config file format, src/io/config.cpp KV2Map).
+
+    '#' starts a comment; first occurrence of a key wins
+    (Config::KeepFirstValues semantics).
+    """
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if "=" not in line:
+            log.warning(f"Unknown config line: {line!r}")
+            continue
+        k, v = line.split("=", 1)
+        k = k.strip()
+        if k and k not in out:
+            out[k] = v.strip()
+    return out
+
+
+class Config:
+    """Resolved parameter set. Attribute access for canonical names."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None):
+        self._values: Dict[str, Any] = {n: d for n, (d, _t, _a, _c) in _PARAMS.items()}
+        self._raw: Dict[str, Any] = {}
+        self.pass_through: Dict[str, Any] = {}
+        if params:
+            self.update(params)
+
+    def update(self, params: Dict[str, Any]) -> None:
+        resolved: Dict[str, Any] = {}
+        for k, v in params.items():
+            name = resolve_alias(k)
+            if name in resolved and resolved[name] != v:
+                log.warning(f"{k} is set with conflicting values, using {resolved[name]}")
+                continue
+            resolved[name] = v
+        for name, v in resolved.items():
+            if name not in _PARAMS:
+                self.pass_through[name] = v
+                continue
+            default, typ, _aliases, check = _PARAMS[name]
+            try:
+                cv = _coerce(name, typ, v)
+            except (ValueError, TypeError) as e:
+                log.fatal(f"Parameter {name}: {e}")
+            if check is not None and cv is not None and not check(cv):
+                log.fatal(f"Parameter {name}={cv} violates its constraint")
+            self._values[name] = cv
+            self._raw[name] = v
+        self._post_process()
+
+    def _post_process(self) -> None:
+        v = self._values
+        # objective alias normalization; rmse/l2_root sets reg_sqrt (config logic)
+        obj = str(v["objective"]).lower()
+        if obj in ("l2_root", "root_mean_squared_error", "rmse"):
+            v["reg_sqrt"] = True
+        if obj in OBJECTIVE_ALIASES:
+            v["objective"] = OBJECTIVE_ALIASES[obj]
+        if v["objective"] in ("multiclass", "multiclassova") and v["num_class"] <= 1:
+            log.fatal("num_class must be >1 for multiclass objectives")
+        if v["objective"] not in ("multiclass", "multiclassova") and v["num_class"] != 1 \
+                and v["objective"] != "none":
+            log.fatal(f"num_class must be 1 for objective {v['objective']}")
+        if v["boosting"] in ("goss",):
+            # boosting=goss is a deprecated spelling of gbdt + goss sampling
+            v["boosting"] = "gbdt"
+            v["data_sample_strategy"] = "goss"
+        if v["seed"] is not None:
+            # seed overrides the individual component seeds (config.h:seed docs)
+            base = int(v["seed"])
+            if "bagging_seed" not in self._raw:
+                v["bagging_seed"] = base + 3
+            if "feature_fraction_seed" not in self._raw:
+                v["feature_fraction_seed"] = base + 2
+            if "drop_seed" not in self._raw:
+                v["drop_seed"] = base + 4
+            if "data_random_seed" not in self._raw:
+                v["data_random_seed"] = base + 1
+            if "extra_seed" not in self._raw:
+                v["extra_seed"] = base + 6
+            if "objective_seed" not in self._raw:
+                v["objective_seed"] = base + 5
+        log.set_verbosity(v["verbosity"])
+
+    def __getattr__(self, name: str) -> Any:
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._values
+
+    def set_explicitly(self, name: str) -> bool:
+        """Whether the user explicitly set this parameter (vs default)."""
+        return name in self._raw
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dict(self._values)
+        d.update(self.pass_through)
+        return d
+
+    def explicit_params(self) -> Dict[str, Any]:
+        d = dict(self._raw)
+        d.update(self.pass_through)
+        return d
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        """K trees per boosting iteration (gbdt.cpp:101 NumModelPerIteration).
+
+        Custom objectives (objective=none) with num_class>1 also train
+        num_class trees per iteration (the caller supplies K*N gradients).
+        """
+        if self._values["objective"] in ("multiclass", "multiclassova", "none"):
+            return int(self._values["num_class"])
+        return 1

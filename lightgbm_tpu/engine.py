@@ -1,0 +1,249 @@
+"""Training entry points: train() and cv() (reference engine.py:109,627)."""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from . import log
+from .basic import Booster, Dataset
+from .callback import CallbackEnv, EarlyStopException
+from .config import Config, resolve_alias
+
+
+def _resolve_num_boost_round(params: Dict[str, Any], num_boost_round: int) -> Tuple[Dict, int]:
+    params = copy.deepcopy(params)
+    for k in list(params.keys()):
+        if resolve_alias(k) == "num_iterations":
+            num_boost_round = int(params.pop(k))
+    return params, num_boost_round
+
+
+def train(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[List[Dataset]] = None,
+    valid_names: Optional[List[str]] = None,
+    feval: Optional[Callable] = None,
+    init_model: Optional[Union[str, Booster]] = None,
+    keep_training_booster: bool = False,
+    callbacks: Optional[List[Callable]] = None,
+    fobj: Optional[Callable] = None,
+) -> Booster:
+    """Train a model (reference engine.py:109 lgb.train)."""
+    params, num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    cfg_probe = Config(params)
+    if cfg_probe.objective == "none" and fobj is None:
+        log.warning("Using custom objective requires fobj; objective=none trains nothing")
+    # early stopping via params (engine.py behavior)
+    callbacks = list(callbacks) if callbacks else []
+    if cfg_probe.early_stopping_round and cfg_probe.early_stopping_round > 0:
+        callbacks.append(
+            callback_mod.early_stopping(
+                cfg_probe.early_stopping_round,
+                first_metric_only=cfg_probe.first_metric_only,
+                min_delta=cfg_probe.early_stopping_min_delta,
+            )
+        )
+    if cfg_probe.verbosity >= 1 and not any(
+        getattr(cb, "order", None) == 10 and not getattr(cb, "before_iteration", False)
+        for cb in callbacks
+    ):
+        callbacks.append(callback_mod.log_evaluation(period=cfg_probe.metric_freq))
+
+    if init_model is not None:
+        raise NotImplementedError("continued training (init_model) is a later milestone")
+
+    booster = Booster(params=params, train_set=train_set)
+    valid_sets = valid_sets or []
+    valid_names = valid_names or []
+    valid_contain_train = False
+    for i, vs in enumerate(valid_sets):
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        if vs is train_set:
+            valid_contain_train = True
+            booster._train_data_name = name
+            continue
+        booster.add_valid(vs, name)
+
+    cb_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
+    cb_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
+    cb_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    cb_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    evaluation_result_list: List[Tuple] = []
+    for i in range(num_boost_round):
+        for cb in cb_before:
+            cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
+        finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_contain_train:
+            evaluation_result_list.extend(booster.eval_train(feval))
+        if booster._gbdt.valids:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cb_after:
+                cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            evaluation_result_list = e.best_score
+            break
+        if finished:
+            break
+
+    # record best score
+    for item in evaluation_result_list or []:
+        booster.best_score.setdefault(item[0], collections.OrderedDict())
+        booster.best_score[item[0]][item[1]] = item[2]
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference engine.py:356)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> "CVBooster":
+        self.boosters.append(booster)
+        return self
+
+    def __getattr__(self, name: str):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params: Dict, seed: int,
+                  stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data()
+    rng = np.random.RandomState(seed)
+    if stratified and full_data.label is not None:
+        label = np.asarray(full_data.label)
+        folds = [[] for _ in range(nfold)]
+        for cls in np.unique(label):
+            idx = np.nonzero(label == cls)[0]
+            if shuffle:
+                rng.shuffle(idx)
+            for i, chunk in enumerate(np.array_split(idx, nfold)):
+                folds[i].extend(chunk.tolist())
+        fold_idx = [np.asarray(sorted(f)) for f in folds]
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        fold_idx = [np.sort(c) for c in np.array_split(idx, nfold)]
+    for i in range(nfold):
+        test_idx = fold_idx[i]
+        train_idx = np.setdiff1d(np.arange(num_data), test_idx, assume_unique=False)
+        yield train_idx, test_idx
+
+
+def cv(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    folds=None,
+    nfold: int = 5,
+    stratified: bool = True,
+    shuffle: bool = True,
+    metrics=None,
+    feval=None,
+    init_model=None,
+    fpreproc=None,
+    seed: int = 0,
+    callbacks=None,
+    eval_train_metric: bool = False,
+    return_cvbooster: bool = False,
+    fobj: Optional[Callable] = None,
+) -> Dict[str, Any]:
+    """Cross-validation (reference engine.py:627)."""
+    params, num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg_probe = Config(params)
+    if cfg_probe.objective in ("lambdarank", "rank_xendcg") and stratified:
+        stratified = False
+
+    if folds is not None:
+        if hasattr(folds, "split"):
+            fold_iter = list(folds.split(np.zeros(train_set.num_data()), train_set.label))
+        else:
+            fold_iter = list(folds)
+    else:
+        fold_iter = list(_make_n_folds(train_set, nfold, params, seed, stratified, shuffle))
+
+    cvbooster = CVBooster()
+    for train_idx, test_idx in fold_iter:
+        tr = train_set.subset(train_idx)
+        te = train_set.subset(test_idx)
+        if fpreproc is not None:
+            tr, te, fold_params = fpreproc(tr, te, copy.deepcopy(params))
+        else:
+            fold_params = params
+        bst = Booster(params=fold_params, train_set=tr)
+        bst.add_valid(te, "valid")
+        cvbooster.append(bst)
+
+    callbacks = list(callbacks) if callbacks else []
+    if cfg_probe.early_stopping_round and cfg_probe.early_stopping_round > 0:
+        callbacks.append(
+            callback_mod.early_stopping(
+                cfg_probe.early_stopping_round,
+                first_metric_only=cfg_probe.first_metric_only,
+                min_delta=cfg_probe.early_stopping_min_delta,
+            )
+        )
+    cb_before = sorted(
+        (cb for cb in callbacks if getattr(cb, "before_iteration", False)),
+        key=lambda cb: getattr(cb, "order", 0),
+    )
+    cb_after = sorted(
+        (cb for cb in callbacks if not getattr(cb, "before_iteration", False)),
+        key=lambda cb: getattr(cb, "order", 0),
+    )
+
+    results = collections.defaultdict(list)
+    for i in range(num_boost_round):
+        for cb in cb_before:
+            cb(CallbackEnv(cvbooster, params, i, 0, num_boost_round, None))
+        for bst in cvbooster.boosters:
+            bst.update(fobj=fobj)
+        # aggregate
+        merged: Dict[Tuple[str, str, bool], List[float]] = collections.OrderedDict()
+        for bst in cvbooster.boosters:
+            one = bst.eval_valid(feval)
+            if eval_train_metric:
+                one = bst.eval_train(feval) + one
+            for dn, mn, v, hb in one:
+                merged.setdefault((dn, mn, hb), []).append(v)
+        agg = [
+            ("cv_agg", f"{dn} {mn}", float(np.mean(vs)), hb, float(np.std(vs)))
+            for (dn, mn, hb), vs in merged.items()
+        ]
+        for (dn, mn, hb), vs in merged.items():
+            results[f"{dn} {mn}-mean"].append(float(np.mean(vs)))
+            results[f"{dn} {mn}-stdv"].append(float(np.std(vs)))
+        try:
+            for cb in cb_after:
+                cb(CallbackEnv(cvbooster, params, i, 0, num_boost_round, agg))
+        except EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for bst in cvbooster.boosters:
+                bst.best_iteration = cvbooster.best_iteration
+            for k in results:
+                results[k] = results[k][: cvbooster.best_iteration]
+            break
+    out = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

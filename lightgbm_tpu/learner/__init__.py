@@ -1,0 +1,19 @@
+"""Tree learner: TPU-native leaf-wise GBDT tree growth.
+
+The package-level split mirrors the reference learner decomposition
+(src/treelearner/cuda/): histogram construction
+(cuda_histogram_constructor.cu -> histogram.py), best-split search
+(cuda_best_split_finder.cu -> split.py), partition + growth loop
+(cuda_data_partition.cu + cuda_single_gpu_tree_learner.cpp -> grower.py).
+"""
+
+from .grower import GrowerSpec, TreeArrays, grow_tree, make_split_params
+from .histogram import leaf_histogram
+
+__all__ = [
+    "GrowerSpec",
+    "TreeArrays",
+    "grow_tree",
+    "make_split_params",
+    "leaf_histogram",
+]

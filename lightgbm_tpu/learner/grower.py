@@ -1,0 +1,292 @@
+"""Leaf-wise tree growth under jit.
+
+Reimplements the reference's leaf-wise learner loop
+(src/treelearner/serial_tree_learner.cpp:182-239 Train, CUDA analog
+cuda_single_gpu_tree_learner.cpp) as a `lax.while_loop` with static
+shapes:
+
+- the partition is a flat per-row leaf-id vector updated with masked
+  `where` (reference CUDA data_index_to_leaf_index,
+  cuda_data_partition.cu:113) — no index lists, no compaction;
+- per-leaf histograms live in a fixed (num_leaves, F, B, 3) tensor
+  (the reference's HistogramPool, feature_histogram.hpp:1367, without
+  eviction — recompute-free subtraction needs the parent kept);
+- each split computes the smaller child's histogram by masked scan and
+  derives the larger by subtraction (serial_tree_learner.cpp:411
+  ConstructHistograms smaller-leaf trick);
+- leaf numbering matches Tree::Split (src/io/tree.cpp): the left child
+  keeps the parent leaf's id, the right child gets id = current number
+  of leaves; internal node i is created by split i; children pointers
+  use ~leaf (= -(leaf+1)) encoding;
+- with `axis_name` set, histograms and root sums are `lax.psum`'d over
+  the data mesh axis — the ICI equivalent of the reference's histogram
+  reduce-scatter (data_parallel_tree_learner.cpp:286); every shard then
+  computes identical splits and partitions its local rows in lockstep.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .histogram import leaf_histogram, masked_leaf_histogram, root_sums
+from .split import NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
+
+
+class GrowerSpec(NamedTuple):
+    """Static (compile-time) growth configuration."""
+
+    num_leaves: int
+    num_bins: int  # uniform bin-axis size B
+    max_depth: int  # <= 0 means unlimited
+    axis_name: Optional[str] = None
+
+
+class TreeArrays(NamedTuple):
+    """Fixed-size tree (reference include/LightGBM/tree.h array layout).
+
+    Node arrays have length num_leaves-1, leaf arrays num_leaves. Child
+    pointers: >=0 internal node index, <0 leaf encoded as ~leaf_index.
+    """
+
+    num_nodes: jax.Array  # scalar int32 — actual splits performed
+    node_feature: jax.Array
+    node_bin: jax.Array
+    node_gain: jax.Array
+    node_default_left: jax.Array
+    node_cat: jax.Array
+    node_left: jax.Array
+    node_right: jax.Array
+    node_value: jax.Array  # internal_value: output of the pre-split leaf
+    node_weight: jax.Array  # internal_weight: hessian sum
+    node_count: jax.Array  # internal_count
+    leaf_value: jax.Array
+    leaf_weight: jax.Array
+    leaf_count: jax.Array
+    leaf_depth: jax.Array
+
+
+class _State(NamedTuple):
+    i: jax.Array
+    row_leaf: jax.Array
+    hist: jax.Array  # (L, F, B, 3)
+    leaf_g: jax.Array
+    leaf_h: jax.Array
+    leaf_c: jax.Array
+    leaf_parent: jax.Array
+    best: SplitRecord  # per-leaf arrays (L,)
+    tree: TreeArrays
+
+
+def make_split_params(cfg) -> SplitParams:
+    """Build traced split params from a Config (host side)."""
+    f = lambda v: jnp.float32(v)
+    return SplitParams(
+        lambda_l1=f(cfg.lambda_l1),
+        lambda_l2=f(cfg.lambda_l2),
+        min_data_in_leaf=f(cfg.min_data_in_leaf),
+        min_sum_hessian_in_leaf=f(cfg.min_sum_hessian_in_leaf),
+        min_gain_to_split=f(cfg.min_gain_to_split),
+        max_delta_step=f(cfg.max_delta_step),
+        path_smooth=f(cfg.path_smooth),
+    )
+
+
+def _empty_best(L: int) -> SplitRecord:
+    zi = jnp.zeros(L, jnp.int32)
+    zf = jnp.zeros(L, jnp.float32)
+    zb = jnp.zeros(L, bool)
+    return SplitRecord(
+        gain=jnp.full(L, NEG_INF),
+        feature=zi, bin=zi, default_left=zb, is_cat=zb,
+        left_g=zf, left_h=zf, left_c=zf,
+        right_g=zf, right_h=zf, right_c=zf,
+    )
+
+
+def _set_best(best: SplitRecord, l: jax.Array, rec: SplitRecord, gain: jax.Array) -> SplitRecord:
+    return SplitRecord(
+        gain=best.gain.at[l].set(gain),
+        feature=best.feature.at[l].set(rec.feature),
+        bin=best.bin.at[l].set(rec.bin),
+        default_left=best.default_left.at[l].set(rec.default_left),
+        is_cat=best.is_cat.at[l].set(rec.is_cat),
+        left_g=best.left_g.at[l].set(rec.left_g),
+        left_h=best.left_h.at[l].set(rec.left_h),
+        left_c=best.left_c.at[l].set(rec.left_c),
+        right_g=best.right_g.at[l].set(rec.right_g),
+        right_h=best.right_h.at[l].set(rec.right_h),
+        right_c=best.right_c.at[l].set(rec.right_c),
+    )
+
+
+def _get_best(best: SplitRecord, l: jax.Array) -> SplitRecord:
+    return jax.tree.map(lambda a: a[l], best)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def grow_tree(
+    bins_blocked: jax.Array,  # (nblocks, F, Bk) int32
+    nan_bin: jax.Array,  # (F,)
+    num_bins: jax.Array,  # (F,)
+    mono: jax.Array,  # (F,)
+    is_cat: jax.Array,  # (F,)
+    grad: jax.Array,  # (N,) f32
+    hess: jax.Array,  # (N,) f32
+    mask: jax.Array,  # (N,) f32 — validity * bagging mask
+    feat_mask: jax.Array,  # (F,) bool — per-tree feature_fraction sample
+    params: SplitParams,
+    spec: GrowerSpec,
+) -> Tuple[TreeArrays, jax.Array]:
+    """Grow one tree; returns (tree arrays, per-row leaf assignment)."""
+    L = spec.num_leaves
+    B = spec.num_bins
+    nb, F, Bk = bins_blocked.shape
+    N = nb * Bk
+    ax = spec.axis_name
+
+    gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1)  # (N, 3)
+    root = root_sums(gh, ax)
+
+    hist0 = leaf_histogram(bins_blocked, gh, B)
+    if ax is not None:
+        hist0 = lax.psum(hist0, ax)
+    rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin, mono, is_cat, params, feat_mask)
+
+    hist = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist0)
+    best = _set_best(_empty_best(L), jnp.int32(0), rec0, rec0.gain)
+
+    tree = TreeArrays(
+        num_nodes=jnp.int32(0),
+        node_feature=jnp.zeros(L - 1, jnp.int32),
+        node_bin=jnp.zeros(L - 1, jnp.int32),
+        node_gain=jnp.zeros(L - 1, jnp.float32),
+        node_default_left=jnp.zeros(L - 1, bool),
+        node_cat=jnp.zeros(L - 1, bool),
+        node_left=jnp.zeros(L - 1, jnp.int32),
+        node_right=jnp.zeros(L - 1, jnp.int32),
+        node_value=jnp.zeros(L - 1, jnp.float32),
+        node_weight=jnp.zeros(L - 1, jnp.float32),
+        node_count=jnp.zeros(L - 1, jnp.float32),
+        leaf_value=jnp.zeros(L, jnp.float32).at[0].set(leaf_output(root[0], root[1], params)),
+        leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
+        leaf_count=jnp.zeros(L, jnp.float32).at[0].set(root[2]),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+    )
+
+    state = _State(
+        i=jnp.int32(0),
+        row_leaf=jnp.zeros(N, jnp.int32),
+        hist=hist,
+        leaf_g=jnp.zeros(L, jnp.float32).at[0].set(root[0]),
+        leaf_h=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
+        leaf_c=jnp.zeros(L, jnp.float32).at[0].set(root[2]),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        best=best,
+        tree=tree,
+    )
+
+    def cond(s: _State) -> jax.Array:
+        return (s.i < L - 1) & (jnp.max(s.best.gain) > 0.0)
+
+    def body(s: _State) -> _State:
+        i = s.i
+        t = s.tree
+        l = jnp.argmax(s.best.gain).astype(jnp.int32)
+        rec = _get_best(s.best, l)
+        new = i + 1  # id of the new (right) leaf
+
+        # ---- tree bookkeeping (Tree::Split semantics) ----
+        p = s.leaf_parent[l]
+        pc = jnp.maximum(p, 0)
+        p_is_left = t.node_left[pc] == ~l
+        node_left = t.node_left.at[pc].set(
+            jnp.where((p >= 0) & p_is_left, i, t.node_left[pc])
+        )
+        node_right = t.node_right.at[pc].set(
+            jnp.where((p >= 0) & ~p_is_left, i, t.node_right[pc])
+        )
+        node_left = node_left.at[i].set(~l)
+        node_right = node_right.at[i].set(~new)
+
+        lo = leaf_output(rec.left_g, rec.left_h, params)
+        ro = leaf_output(rec.right_g, rec.right_h, params)
+        depth_new = t.leaf_depth[l] + 1
+
+        tree_new = TreeArrays(
+            num_nodes=new,
+            node_feature=t.node_feature.at[i].set(rec.feature),
+            node_bin=t.node_bin.at[i].set(rec.bin),
+            node_gain=t.node_gain.at[i].set(rec.gain),
+            node_default_left=t.node_default_left.at[i].set(rec.default_left),
+            node_cat=t.node_cat.at[i].set(rec.is_cat),
+            node_left=node_left,
+            node_right=node_right,
+            node_value=t.node_value.at[i].set(leaf_output(s.leaf_g[l], s.leaf_h[l], params)),
+            node_weight=t.node_weight.at[i].set(s.leaf_h[l]),
+            node_count=t.node_count.at[i].set(s.leaf_c[l]),
+            leaf_value=t.leaf_value.at[l].set(lo).at[new].set(ro),
+            leaf_weight=t.leaf_weight.at[l].set(rec.left_h).at[new].set(rec.right_h),
+            leaf_count=t.leaf_count.at[l].set(rec.left_c).at[new].set(rec.right_c),
+            leaf_depth=t.leaf_depth.at[l].set(depth_new).at[new].set(depth_new),
+        )
+
+        # ---- partition: update per-row leaf ids (cuda_data_partition.cu) ----
+        f = rec.feature
+        fbins = lax.dynamic_slice_in_dim(bins_blocked, f, 1, axis=1).reshape(N)
+        fnan = nan_bin[f]
+        go_left = jnp.where(
+            rec.is_cat,
+            fbins == rec.bin,
+            (fbins <= rec.bin) | (rec.default_left & (fbins == fnan) & (fnan >= 0)),
+        )
+        on_leaf = s.row_leaf == l
+        row_leaf = jnp.where(on_leaf & ~go_left, new, s.row_leaf)
+
+        # ---- child histograms: smaller by masked scan, larger by subtraction
+        parent_hist = s.hist[l]
+        left_smaller = rec.left_c <= rec.right_c
+        small_id = jnp.where(left_smaller, l, new)
+        small_hist = masked_leaf_histogram(bins_blocked, gh, row_leaf, small_id, B)
+        if ax is not None:
+            small_hist = lax.psum(small_hist, ax)
+        large_hist = parent_hist - small_hist
+        left_hist = jnp.where(left_smaller, small_hist, large_hist)
+        right_hist = jnp.where(left_smaller, large_hist, small_hist)
+        hist = s.hist.at[l].set(left_hist).at[new].set(right_hist)
+
+        # ---- best splits for both children ----
+        bl = best_split(left_hist, rec.left_g, rec.left_h, rec.left_c,
+                        num_bins, nan_bin, mono, is_cat, params, feat_mask)
+        br = best_split(right_hist, rec.right_g, rec.right_h, rec.right_c,
+                        num_bins, nan_bin, mono, is_cat, params, feat_mask)
+        depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
+        best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
+        best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))
+
+        return _State(
+            i=new,
+            row_leaf=row_leaf,
+            hist=hist,
+            leaf_g=s.leaf_g.at[l].set(rec.left_g).at[new].set(rec.right_g),
+            leaf_h=s.leaf_h.at[l].set(rec.left_h).at[new].set(rec.right_h),
+            leaf_c=s.leaf_c.at[l].set(rec.left_c).at[new].set(rec.right_c),
+            leaf_parent=s.leaf_parent.at[l].set(i).at[new].set(i),
+            best=best2,
+            tree=tree_new,
+        )
+
+    final = lax.while_loop(cond, body, state)
+    return final.tree, final.row_leaf
+
+
+@jax.jit
+def add_score(score: jax.Array, row_leaf: jax.Array, leaf_value: jax.Array,
+              shrinkage: jax.Array) -> jax.Array:
+    """ScoreUpdater::AddScore via the partition vector
+    (reference score_updater.hpp:21 + data-partition fast path)."""
+    return score + shrinkage * leaf_value[row_leaf]

@@ -1,0 +1,71 @@
+"""Feature-histogram construction as MXU matmuls.
+
+The reference builds per-(leaf, feature) histograms of (sum_grad,
+sum_hess, count) with sequential scatter loops on CPU
+(src/io/dense_bin.hpp:99-174 ConstructHistogram) and shared-memory
+atomics on CUDA (src/treelearner/cuda/cuda_histogram_constructor.cu).
+Scatter-add is the wrong primitive for a TPU; instead each block of rows
+is expanded to a one-hot {0,1} matrix over the bin axis and contracted
+against the (grad, hess, count) channels — a batched matmul that tiles
+onto the MXU. A `lax.scan` over row blocks bounds the one-hot
+materialization to one block at a time.
+
+Accumulation is float32 (`preferred_element_type`), matching the CUDA
+backend's float histograms (gpu_hist_t) rather than the CPU's doubles.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def leaf_histogram(
+    bins_blocked: jax.Array,  # (nblocks, F, Bk) int32 — feature-major row blocks
+    gh: jax.Array,  # (N, 3) float32 — (grad, hess, count) already masked to the leaf
+    num_bins: int,  # uniform bin-axis size B
+) -> jax.Array:
+    """Return (F, B, 3) histogram of the rows whose gh mask is nonzero."""
+    nblocks, F, Bk = bins_blocked.shape
+    gh_blocked = gh.reshape(nblocks, Bk, 3)
+
+    iota = jnp.arange(num_bins, dtype=bins_blocked.dtype)
+
+    def body(acc, xs):
+        b, g = xs  # (F, Bk) int, (Bk, 3) f32
+        onehot = (b[:, :, None] == iota).astype(jnp.float32)  # (F, Bk, B)
+        acc = acc + jnp.einsum(
+            "frb,rc->fbc", onehot, g, preferred_element_type=jnp.float32
+        )
+        return acc, None
+
+    init = jnp.zeros((F, num_bins, 3), dtype=jnp.float32)
+    hist, _ = lax.scan(body, init, (bins_blocked, gh_blocked))
+    return hist
+
+
+def masked_leaf_histogram(
+    bins_blocked: jax.Array,
+    gh_all: jax.Array,  # (N, 3) masked for validity/bagging but not leaf
+    row_leaf: jax.Array,  # (N,) int32
+    leaf: jax.Array,  # scalar int32
+    num_bins: int,
+) -> jax.Array:
+    """Histogram of rows currently assigned to `leaf`."""
+    mask = (row_leaf == leaf).astype(gh_all.dtype)
+    return leaf_histogram(bins_blocked, gh_all * mask[:, None], num_bins)
+
+
+def root_sums(gh: jax.Array, axis_name: Optional[str] = None) -> jax.Array:
+    """(sum_grad, sum_hess, count) over all in-bag rows; float64-free but
+    accumulated in f32 pairwise by jnp.sum. Globally reduced over the data
+    mesh axis when present (reference data_parallel_tree_learner.cpp:169-221
+    root allreduce)."""
+    s = jnp.sum(gh, axis=0)
+    if axis_name is not None:
+        s = lax.psum(s, axis_name)
+    return s

@@ -1,0 +1,197 @@
+"""Vectorized best-split search over (feature, threshold, missing-direction).
+
+Reimplements the split-gain math of the reference threshold scan
+(src/treelearner/feature_histogram.hpp:832 FindBestThresholdSequentially,
+CUDA analog src/treelearner/cuda/cuda_best_split_finder.cu) as cumulative
+sums over the bin axis plus a masked argmax — no sequential per-bin loop:
+
+- L1/L2 regularization via ThresholdL1 soft-thresholding
+  (feature_histogram.hpp GetLeafGain/CalculateSplittedLeafOutput),
+- missing-value handling: NaN bin is the last bin of a feature; both
+  default directions are evaluated (the reference's double scan),
+- categorical features use one-vs-rest splits (bin == t goes left);
+  the sorted-subset search (feature_histogram.hpp:449) is a later
+  milestone,
+- min_data_in_leaf / min_sum_hessian_in_leaf / min_gain_to_split masks,
+- monotone-constraint candidate masking (basic method),
+- tie-break: argmax over arrays laid out (dir, F, B) flattened picks the
+  lowest flat index, matching the reference's first-feature-wins
+  strictly-greater update order.
+
+Gains are stored shifted by (parent_gain + min_gain_to_split) so that
+"> 0" means a valid improving split, as in the reference SplitInfo.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-1e30)
+K_EPSILON = 1e-15  # reference kEpsilon (meta.h)
+
+
+class SplitParams(NamedTuple):
+    """Dynamic (traced) split hyper-parameters."""
+
+    lambda_l1: jax.Array
+    lambda_l2: jax.Array
+    min_data_in_leaf: jax.Array
+    min_sum_hessian_in_leaf: jax.Array
+    min_gain_to_split: jax.Array
+    max_delta_step: jax.Array
+    path_smooth: jax.Array
+
+
+class SplitRecord(NamedTuple):
+    """Best split for one leaf (reference split_info.hpp:22 SplitInfo)."""
+
+    gain: jax.Array  # f32, shifted; <=0 means no valid split
+    feature: jax.Array  # int32, used-feature index
+    bin: jax.Array  # int32 threshold bin (or category bin for 1-vs-rest)
+    default_left: jax.Array  # bool
+    is_cat: jax.Array  # bool
+    left_g: jax.Array
+    left_h: jax.Array
+    left_c: jax.Array
+    right_g: jax.Array
+    right_h: jax.Array
+    right_c: jax.Array
+
+
+def threshold_l1(s: jax.Array, l1: jax.Array) -> jax.Array:
+    """reference feature_histogram.hpp ThresholdL1."""
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_output(g: jax.Array, h: jax.Array, p: SplitParams) -> jax.Array:
+    """CalculateSplittedLeafOutput (no constraints): -T(G)/(H+l2), clipped
+    by max_delta_step when positive."""
+    out = -threshold_l1(g, p.lambda_l1) / (h + p.lambda_l2 + K_EPSILON)
+    return jnp.where(
+        p.max_delta_step > 0.0,
+        jnp.clip(out, -p.max_delta_step, p.max_delta_step),
+        out,
+    )
+
+
+def leaf_gain(g: jax.Array, h: jax.Array, p: SplitParams) -> jax.Array:
+    """GetLeafGain: T(G)^2/(H+l2); with max_delta_step falls back to
+    GetLeafGainGivenOutput(-(2 T(G) o + (H+l2) o^2))."""
+    t = threshold_l1(g, p.lambda_l1)
+    free = t * t / (h + p.lambda_l2 + K_EPSILON)
+    o = leaf_output(g, h, p)
+    clipped = -(2.0 * t * o + (h + p.lambda_l2) * o * o)
+    return jnp.where(p.max_delta_step > 0.0, clipped, free)
+
+
+def best_split(
+    hist: jax.Array,  # (F, B, 3) f32
+    sum_g: jax.Array,
+    sum_h: jax.Array,
+    sum_c: jax.Array,
+    num_bins: jax.Array,  # (F,) int32
+    nan_bin: jax.Array,  # (F,) int32, -1 if feature has no NaN bin
+    mono: jax.Array,  # (F,) int32 in {-1, 0, 1}
+    is_cat: jax.Array,  # (F,) bool
+    params: SplitParams,
+    feat_mask: Optional[jax.Array] = None,  # (F,) bool — ColSampler feature_fraction
+) -> SplitRecord:
+    """Find the best split of a leaf with given histogram and totals."""
+    F, B, _ = hist.shape
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    c = hist[:, :, 2]
+    bin_idx = jnp.arange(B, dtype=jnp.int32)[None, :]  # (1, B)
+
+    has_nan = (nan_bin >= 0)[:, None]  # (F, 1)
+    nan_g = jnp.where(has_nan[:, 0], jnp.take_along_axis(g, jnp.maximum(nan_bin, 0)[:, None], axis=1)[:, 0], 0.0)[:, None]
+    nan_h = jnp.where(has_nan[:, 0], jnp.take_along_axis(h, jnp.maximum(nan_bin, 0)[:, None], axis=1)[:, 0], 0.0)[:, None]
+    nan_c = jnp.where(has_nan[:, 0], jnp.take_along_axis(c, jnp.maximum(nan_bin, 0)[:, None], axis=1)[:, 0], 0.0)[:, None]
+
+    # ---- numerical: cumulative left sums, threshold t keeps bins <= t left.
+    cg = jnp.cumsum(g, axis=1)
+    ch = jnp.cumsum(h, axis=1)
+    cc = jnp.cumsum(c, axis=1)
+
+    def eval_lr(lg, lh, lc):
+        rg = sum_g - lg
+        rh = sum_h - lh
+        rc = sum_c - lc
+        gains = leaf_gain(lg, lh, params) + leaf_gain(rg, rh, params)
+        ok = (
+            (lc >= params.min_data_in_leaf)
+            & (rc >= params.min_data_in_leaf)
+            & (lh >= params.min_sum_hessian_in_leaf)
+            & (rh >= params.min_sum_hessian_in_leaf)
+        )
+        # monotone basic: candidate-level output ordering
+        lo = leaf_output(lg, lh, params)
+        ro = leaf_output(rg, rh, params)
+        m = mono[:, None]
+        ok &= jnp.where(m > 0, lo <= ro, True)
+        ok &= jnp.where(m < 0, lo >= ro, True)
+        return gains, ok, (lg, lh, lc)
+
+    # NaN bin (last bin) is never <= t for valid t, so cum excludes it.
+    # default right: missing stays right.
+    gain_dr, ok_dr, _ = eval_lr(cg, ch, cc)
+    # default left: NaN bin mass joins the left side.
+    gain_dl, ok_dl, _ = eval_lr(cg + nan_g, ch + nan_h, cc + nan_c)
+    # only evaluate the default-left variant when the feature has a NaN bin
+    ok_dl &= has_nan
+
+    # threshold validity: t in [0, num_bin-2], excluding the NaN bin itself
+    last_real = jnp.where(nan_bin[:, None] >= 0, num_bins[:, None] - 2, num_bins[:, None] - 1)
+    t_ok = bin_idx < last_real
+    num_mask = (~is_cat)[:, None] & t_ok
+    ok_dr &= num_mask
+    ok_dl &= num_mask
+
+    # ---- categorical one-vs-rest: bin t alone goes left.
+    gain_cat, ok_cat, _ = eval_lr(g, h, c)
+    ok_cat &= is_cat[:, None] & (bin_idx < num_bins[:, None])
+
+    parent_gain = leaf_gain(sum_g, sum_h, params)
+    shift = parent_gain + params.min_gain_to_split
+
+    # stack: dir axis LAST in flat order (F, B, 3) so ties break on
+    # feature, then bin, then (dr, dl, cat) — reference scans features in
+    # order and keeps strictly-greater gains.
+    gains = jnp.stack([gain_dr, gain_dl, gain_cat], axis=-1) - shift  # (F, B, 3)
+    ok = jnp.stack([ok_dr, ok_dl, ok_cat], axis=-1)
+    if feat_mask is not None:
+        ok &= feat_mask[:, None, None]
+    gains = jnp.where(ok, gains, NEG_INF)
+
+    flat = gains.reshape(-1)
+    idx = jnp.argmax(flat)
+    best_gain = flat[idx]
+    f = (idx // (B * 3)).astype(jnp.int32)
+    b = ((idx // 3) % B).astype(jnp.int32)
+    d = (idx % 3).astype(jnp.int32)
+    default_left = d == 1
+    cat = d == 2
+
+    lg_num = cg[f, b] + jnp.where(default_left, nan_g[f, 0], 0.0)
+    lh_num = ch[f, b] + jnp.where(default_left, nan_h[f, 0], 0.0)
+    lc_num = cc[f, b] + jnp.where(default_left, nan_c[f, 0], 0.0)
+    lg = jnp.where(cat, g[f, b], lg_num)
+    lh = jnp.where(cat, h[f, b], lh_num)
+    lc = jnp.where(cat, c[f, b], lc_num)
+
+    return SplitRecord(
+        gain=best_gain,
+        feature=f,
+        bin=b,
+        default_left=default_left,
+        is_cat=cat,
+        left_g=lg,
+        left_h=lh,
+        left_c=lc,
+        right_g=sum_g - lg,
+        right_h=sum_h - lh,
+        right_c=sum_c - lc,
+    )

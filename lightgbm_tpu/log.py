@@ -1,0 +1,69 @@
+"""Leveled logging with a pluggable callback.
+
+Mirrors the reference logger (include/LightGBM/utils/log.h:88): levels
+Debug/Info/Warning/Fatal keyed off the `verbosity` (alias `verbose`)
+config value, with a registerable redirection callback
+(log.h:97, python-package basic.py register_logger).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Any, Callable, Optional
+
+_logger: Optional[Any] = None
+_info_method = "info"
+_warning_method = "warning"
+
+# verbosity: <0 Fatal only, 0 Warning, 1 Info (default), >=2 Debug
+_VERBOSITY = 1
+
+
+class LightGBMError(Exception):
+    """Error raised by lightgbm_tpu (reference: include/LightGBM/utils/log.h Fatal)."""
+
+
+def register_logger(
+    logger: Any, info_method_name: str = "info", warning_method_name: str = "warning"
+) -> None:
+    """Redirect framework log output to a custom logger object."""
+    global _logger, _info_method, _warning_method
+    if not callable(getattr(logger, info_method_name, None)):
+        raise TypeError(f"logger has no callable method {info_method_name!r}")
+    if not callable(getattr(logger, warning_method_name, None)):
+        raise TypeError(f"logger has no callable method {warning_method_name!r}")
+    _logger = logger
+    _info_method = info_method_name
+    _warning_method = warning_method_name
+
+
+def set_verbosity(v: int) -> None:
+    global _VERBOSITY
+    _VERBOSITY = int(v)
+
+
+def _emit(msg: str, warning: bool = False) -> None:
+    if _logger is not None:
+        getattr(_logger, _warning_method if warning else _info_method)(msg)
+    else:
+        print(msg, file=sys.stderr if warning else sys.stdout, flush=True)
+
+
+def debug(msg: str) -> None:
+    if _VERBOSITY >= 2:
+        _emit(f"[LightGBM-TPU] [Debug] {msg}")
+
+
+def info(msg: str) -> None:
+    if _VERBOSITY >= 1:
+        _emit(f"[LightGBM-TPU] [Info] {msg}")
+
+
+def warning(msg: str) -> None:
+    if _VERBOSITY >= 0:
+        _emit(f"[LightGBM-TPU] [Warning] {msg}", warning=True)
+
+
+def fatal(msg: str) -> None:
+    raise LightGBMError(msg)

@@ -1,0 +1,511 @@
+"""Objective functions: gradients/hessians as jitted device functions.
+
+Reimplements the reference objective layer
+(include/LightGBM/objective_function.h:19, src/objective/*.hpp) with the
+same math, factory names and aliases (objective_function.cpp:22). Each
+objective produces per-row (grad, hess) from the current score on device
+— the TPU analog of the CUDA objectives (src/objective/cuda/) that keep
+the boosting state device-resident.
+
+Scores/labels are padded row vectors; padding rows produce garbage
+gradients that the grower masks out via its validity channel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import log
+from .config import Config
+from .dataset import BinnedDataset, Metadata
+
+
+class ObjectiveFunction:
+    """Base objective (reference objective_function.h:19)."""
+
+    name = "custom"
+    num_class = 1
+    is_ranking = False
+    # objectives that refit leaf outputs with residual percentiles
+    # (objective_function.h:55 IsRenewTreeOutput)
+    is_renew_tree_output = False
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.label: Optional[jax.Array] = None
+        self.weight: Optional[jax.Array] = None
+
+    def init(self, dataset: BinnedDataset) -> None:
+        meta = dataset.metadata
+        if meta.label is None:
+            log.fatal(f"objective {self.name} requires labels")
+        self.check_label(meta.label)
+        self.label = jnp.asarray(dataset.padded(meta.label))
+        self.weight = (
+            jnp.asarray(dataset.padded(meta.weight))
+            if meta.weight is not None
+            else None
+        )
+        self._meta = meta
+        self._num_data = dataset.num_data
+
+    def check_label(self, label: np.ndarray) -> None:
+        pass
+
+    def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int) -> float:
+        return 0.0
+
+    def convert_output(self, score: np.ndarray) -> np.ndarray:
+        """Raw score -> prediction space (sigmoid/exp/softmax)."""
+        return score
+
+    def _w(self, g: jax.Array, h: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        if self.weight is not None:
+            return g * self.weight, h * self.weight
+        return g, h
+
+
+# ---------------------------------------------------------------- regression
+class RegressionL2(ObjectiveFunction):
+    """reference regression_objective.hpp RegressionL2loss."""
+
+    name = "regression"
+
+    def init(self, dataset: BinnedDataset) -> None:
+        super().init(dataset)
+        if self.config.reg_sqrt:
+            lab = np.asarray(self.label)
+            self.label = jnp.sign(jnp.asarray(lab)) * jnp.sqrt(jnp.abs(jnp.asarray(lab)))
+
+    def get_gradients(self, score):
+        return self._w(score - self.label, jnp.ones_like(score))
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        w = self._np_weight()
+        return float(np.average(lab, weights=w))
+
+    def _np_weight(self):
+        return (
+            np.asarray(self.weight)[: self._num_data]
+            if self.weight is not None
+            else None
+        )
+
+    def convert_output(self, score):
+        if self.config.reg_sqrt:
+            return np.sign(score) * score * score
+        return score
+
+
+class RegressionL1(RegressionL2):
+    name = "regression_l1"
+    is_renew_tree_output = True
+
+    def get_gradients(self, score):
+        return self._w(jnp.sign(score - self.label), jnp.ones_like(score))
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        w = self._np_weight()
+        if w is None:
+            return float(np.percentile(lab, 50))
+        return _weighted_percentile(lab, w, 0.5)
+
+    def renew_percentile(self) -> float:
+        return 0.5
+
+
+class Huber(RegressionL2):
+    name = "huber"
+    is_renew_tree_output = True
+
+    def get_gradients(self, score):
+        d = score - self.label
+        a = jnp.float32(self.config.alpha)
+        g = jnp.where(jnp.abs(d) <= a, d, jnp.sign(d) * a)
+        return self._w(g, jnp.ones_like(score))
+
+    def renew_percentile(self) -> float:
+        return 0.5
+
+
+class Fair(RegressionL2):
+    name = "fair"
+
+    def get_gradients(self, score):
+        d = score - self.label
+        c = jnp.float32(self.config.fair_c)
+        return self._w(c * d / (jnp.abs(d) + c), c * c / (jnp.abs(d) + c) ** 2)
+
+    def boost_from_score(self, class_id: int) -> float:
+        return 0.0
+
+
+class Poisson(RegressionL2):
+    name = "poisson"
+
+    def check_label(self, label):
+        if np.any(label < 0):
+            log.fatal("[poisson]: at least one target label is negative")
+
+    def get_gradients(self, score):
+        mds = jnp.float32(self.config.poisson_max_delta_step)
+        return self._w(jnp.exp(score) - self.label, jnp.exp(score + mds))
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        return float(np.log(max(np.average(lab, weights=self._np_weight()), 1e-20)))
+
+    def convert_output(self, score):
+        return np.exp(score)
+
+
+class Quantile(RegressionL2):
+    name = "quantile"
+    is_renew_tree_output = True
+
+    def get_gradients(self, score):
+        a = jnp.float32(self.config.alpha)
+        g = jnp.where(score > self.label, 1.0 - a, -a)
+        return self._w(g, jnp.ones_like(score))
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        w = self._np_weight()
+        if w is None:
+            return float(np.percentile(lab, self.config.alpha * 100))
+        return _weighted_percentile(lab, w, self.config.alpha)
+
+    def renew_percentile(self) -> float:
+        return float(self.config.alpha)
+
+
+class MAPE(RegressionL2):
+    name = "mape"
+    is_renew_tree_output = True
+
+    def init(self, dataset):
+        super().init(dataset)
+        lab = np.asarray(self.label)
+        lw = 1.0 / np.maximum(1.0, np.abs(lab))
+        if self.weight is not None:
+            lw = lw * np.asarray(self.weight)
+        self._label_weight = jnp.asarray(lw.astype(np.float32))
+
+    def get_gradients(self, score):
+        g = jnp.sign(score - self.label) * self._label_weight
+        return g, self._label_weight
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        w = np.asarray(self._label_weight)[: self._num_data]
+        return _weighted_percentile(lab, w, 0.5)
+
+    def renew_percentile(self) -> float:
+        return 0.5
+
+
+class Gamma(Poisson):
+    name = "gamma"
+
+    def get_gradients(self, score):
+        return self._w(
+            1.0 - self.label * jnp.exp(-score), self.label * jnp.exp(-score)
+        )
+
+
+class Tweedie(Poisson):
+    name = "tweedie"
+
+    def get_gradients(self, score):
+        rho = jnp.float32(self.config.tweedie_variance_power)
+        e1 = jnp.exp((1.0 - rho) * score)
+        e2 = jnp.exp((2.0 - rho) * score)
+        g = -self.label * e1 + e2
+        h = -self.label * (1.0 - rho) * e1 + (2.0 - rho) * e2
+        return self._w(g, h)
+
+
+# ---------------------------------------------------------------- binary
+class Binary(ObjectiveFunction):
+    """reference binary_objective.hpp: labels {0,1} -> {-1,+1}, sigmoid
+    scaling, is_unbalance / scale_pos_weight label weighting."""
+
+    name = "binary"
+
+    def check_label(self, label):
+        u = np.unique(label)
+        if not np.all(np.isin(u, [0, 1])):
+            log.fatal("[binary]: labels must be 0 or 1")
+
+    def init(self, dataset):
+        super().init(dataset)
+        lab = np.asarray(self.label)[: self._num_data]
+        cnt_pos = float(np.sum(lab == 1))
+        cnt_neg = float(np.sum(lab == 0))
+        if self.config.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                self._pos_w, self._neg_w = 1.0, cnt_pos / cnt_neg
+            else:
+                self._pos_w, self._neg_w = cnt_neg / cnt_pos, 1.0
+        else:
+            self._pos_w = float(self.config.scale_pos_weight)
+            self._neg_w = 1.0
+        self._cnt_pos, self._cnt_neg = cnt_pos, cnt_neg
+
+    def get_gradients(self, score):
+        sig = jnp.float32(self.config.sigmoid)
+        y = self.label  # 0/1
+        p = jax.nn.sigmoid(sig * score)
+        lw = jnp.where(y > 0, self._pos_w, self._neg_w)
+        g = (p - y) * sig * lw
+        h = p * (1.0 - p) * sig * sig * lw
+        return self._w(g, h)
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        w = (
+            np.asarray(self.weight)[: self._num_data]
+            if self.weight is not None
+            else np.ones_like(lab)
+        )
+        lw = np.where(lab > 0, self._pos_w, self._neg_w) * w
+        pavg = float(np.sum(lab * lw) / max(np.sum(lw), 1e-20))
+        pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
+        return float(np.log(pavg / (1.0 - pavg)) / self.config.sigmoid)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.config.sigmoid * score))
+
+
+# ---------------------------------------------------------------- multiclass
+class MulticlassSoftmax(ObjectiveFunction):
+    """reference multiclass_objective.hpp MulticlassSoftmax."""
+
+    name = "multiclass"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = config.num_class
+
+    def check_label(self, label):
+        if np.any(label < 0) or np.any(label >= self.num_class):
+            log.fatal("[multiclass]: label must be in [0, num_class)")
+
+    def get_gradients(self, score):
+        # score: (K, N)
+        p = jax.nn.softmax(score, axis=0)
+        y = jax.nn.one_hot(self.label.astype(jnp.int32), self.num_class).T
+        g = p - y
+        h = 2.0 * p * (1.0 - p)  # reference factor 2
+        if self.weight is not None:
+            g = g * self.weight[None, :]
+            h = h * self.weight[None, :]
+        return g, h
+
+    def convert_output(self, score):
+        e = np.exp(score - np.max(score, axis=0, keepdims=True))
+        return e / np.sum(e, axis=0, keepdims=True)
+
+
+class MulticlassOVA(ObjectiveFunction):
+    """One-vs-all: K independent sigmoid binaries (multiclass_objective.hpp)."""
+
+    name = "multiclassova"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = config.num_class
+
+    def get_gradients(self, score):
+        sig = jnp.float32(self.config.sigmoid)
+        y = jax.nn.one_hot(self.label.astype(jnp.int32), self.num_class).T
+        p = jax.nn.sigmoid(sig * score)
+        g = (p - y) * sig
+        h = p * (1.0 - p) * sig * sig
+        if self.weight is not None:
+            g = g * self.weight[None, :]
+            h = h * self.weight[None, :]
+        return g, h
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        p = float(np.mean(lab == class_id))
+        p = min(max(p, 1e-15), 1.0 - 1e-15)
+        return float(np.log(p / (1.0 - p)) / self.config.sigmoid)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.config.sigmoid * score))
+
+
+# ---------------------------------------------------------------- xentropy
+class CrossEntropy(ObjectiveFunction):
+    """reference xentropy_objective.hpp: labels in [0,1]."""
+
+    name = "cross_entropy"
+
+    def check_label(self, label):
+        if np.any(label < 0) or np.any(label > 1):
+            log.fatal("[cross_entropy]: labels must be in [0, 1]")
+
+    def get_gradients(self, score):
+        p = jax.nn.sigmoid(score)
+        return self._w(p - self.label, p * (1.0 - p))
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        w = (
+            np.asarray(self.weight)[: self._num_data]
+            if self.weight is not None
+            else None
+        )
+        pavg = float(np.average(lab, weights=w))
+        pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
+        return float(np.log(pavg / (1.0 - pavg)))
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-score))
+
+
+# ---------------------------------------------------------------- ranking
+class LambdaRank(ObjectiveFunction):
+    """reference rank_objective.hpp LambdarankNDCG.
+
+    Gradient computation runs on host (numpy) per iteration for now:
+    query groups are variable-sized and small; the padded segment-ops
+    device version is a later milestone.
+    """
+
+    name = "lambdarank"
+    is_ranking = True
+
+    def init(self, dataset):
+        super().init(dataset)
+        if self._meta.group is None:
+            log.fatal("lambdarank requires query group information")
+        self._qb = self._meta.query_boundaries()
+        label = np.asarray(self._meta.label)
+        gains = list(self.config.label_gain)
+        if not gains:
+            max_label = int(label.max())
+            gains = [(1 << i) - 1 for i in range(max_label + 1)]
+        self._label_gain = np.asarray(gains, dtype=np.float64)
+        self._trunc = int(self.config.lambdarank_truncation_level)
+        self._norm = bool(self.config.lambdarank_norm)
+        self._sigmoid = float(self.config.sigmoid)
+        # inverse max DCG per query at truncation level
+        self._inv_max_dcg = np.zeros(len(self._qb) - 1)
+        for q in range(len(self._qb) - 1):
+            lab = label[self._qb[q]: self._qb[q + 1]].astype(int)
+            srt = np.sort(lab)[::-1][: self._trunc]
+            dcg = np.sum(self._label_gain[srt] / np.log2(np.arange(len(srt)) + 2))
+            self._inv_max_dcg[q] = 1.0 / dcg if dcg > 0 else 0.0
+        self._npad = len(np.asarray(self.label))
+
+    def get_gradients(self, score):
+        s = np.asarray(score)[: self._num_data].astype(np.float64)
+        label = np.asarray(self._meta.label).astype(int)
+        g = np.zeros(self._num_data)
+        h = np.zeros(self._num_data)
+        lg = self._label_gain
+        sig = self._sigmoid
+        for q in range(len(self._qb) - 1):
+            lo, hi = self._qb[q], self._qb[q + 1]
+            cnt = hi - lo
+            if cnt <= 1 or self._inv_max_dcg[q] == 0:
+                continue
+            sq = s[lo:hi]
+            lq = label[lo:hi]
+            order = np.argsort(-sq, kind="stable")
+            k = min(self._trunc, cnt)
+            # position discount by sorted rank (rank_objective.hpp:150-230):
+            # pairs (rank i < truncation) x (rank j > i), labels differ.
+            disc = 1.0 / np.log2(np.arange(cnt) + 2.0)
+            gain = lg[lq]
+            gi = np.zeros(cnt)
+            hi_ = np.zeros(cnt)
+            sum_lambdas = 0.0
+            for pi in range(k):
+                i = order[pi]
+                js = order[pi + 1:]
+                if len(js) == 0:
+                    break
+                dl = lq[i] - lq[js]
+                mask = dl != 0
+                if not np.any(mask):
+                    continue
+                high_is_i = dl > 0
+                ds = np.where(high_is_i, sq[i] - sq[js], sq[js] - sq[i])
+                dndcg = (
+                    np.abs((gain[i] - gain[js]) * (disc[pi] - disc[pi + 1:]))
+                    * self._inv_max_dcg[q]
+                )
+                p = 1.0 / (1.0 + np.exp(sig * ds))  # P(low beats high)
+                lam = sig * p * dndcg * mask
+                hess = sig * sig * p * (1.0 - p) * dndcg * mask
+                # push the high-labeled doc up (negative gradient), low down
+                gi[i] += np.sum(np.where(high_is_i, -lam, lam))
+                np.add.at(gi, js, np.where(high_is_i, lam, -lam))
+                hi_[i] += np.sum(hess)
+                np.add.at(hi_, js, hess)
+                sum_lambdas += 2.0 * np.sum(lam)
+            if self._norm and sum_lambdas > 0:
+                scale = np.log2(1.0 + sum_lambdas) / sum_lambdas
+                gi *= scale
+                hi_ *= scale
+            g[lo:hi] = gi
+            h[lo:hi] = hi_
+        gp = np.zeros(self._npad, np.float32)
+        hp = np.zeros(self._npad, np.float32)
+        gp[: self._num_data] = g
+        hp[: self._num_data] = np.maximum(h, 2e-7)
+        return jnp.asarray(gp), jnp.asarray(hp)
+
+    def convert_output(self, score):
+        return score
+
+
+def _weighted_percentile(values: np.ndarray, weights: np.ndarray, alpha: float) -> float:
+    order = np.argsort(values)
+    v, w = values[order], weights[order]
+    cw = np.cumsum(w)
+    threshold = alpha * cw[-1]
+    idx = int(np.searchsorted(cw, threshold))
+    return float(v[min(idx, len(v) - 1)])
+
+
+_OBJECTIVES: Dict[str, type] = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": Huber,
+    "fair": Fair,
+    "poisson": Poisson,
+    "quantile": Quantile,
+    "mape": MAPE,
+    "gamma": Gamma,
+    "tweedie": Tweedie,
+    "binary": Binary,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "lambdarank": LambdaRank,
+}
+
+
+def create_objective(config: Config) -> Optional[ObjectiveFunction]:
+    """Factory (reference objective_function.cpp:22)."""
+    name = config.objective
+    if name == "none":
+        return None
+    if name not in _OBJECTIVES:
+        log.fatal(f"Unknown objective type name: {name}")
+    return _OBJECTIVES[name](config)

@@ -1,0 +1,15 @@
+"""Distributed training over a jax.sharding.Mesh.
+
+TPU-native replacement for the reference's socket/MPI collective layer
+(src/network/, include/LightGBM/network.h:89) and its parallel tree
+learners (src/treelearner/*_parallel_tree_learner.cpp): rows are sharded
+over a "data" mesh axis, per-shard histograms are globally reduced with
+`lax.psum` riding ICI (the reference's ReduceScatter+Allgather,
+network.cpp:93-95), and every shard then computes the identical best
+split and partitions its local rows in lockstep — exactly the
+data-parallel algorithm, with XLA collectives instead of TCP.
+"""
+
+from .data_parallel import DataParallelGrower, make_mesh
+
+__all__ = ["DataParallelGrower", "make_mesh"]

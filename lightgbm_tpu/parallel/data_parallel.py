@@ -1,0 +1,109 @@
+"""Data-parallel tree growth: rows sharded, histograms psum'd.
+
+Reference algorithm: src/treelearner/data_parallel_tree_learner.cpp —
+  BeforeTrain: allreduce root (count, sum_grad, sum_hess)      (:169-221)
+  FindBestSplits: local hists for all features -> ReduceScatter (:286)
+  best split on aggregated hists -> allreduce-max split         (:443)
+  Split: identical on all ranks using global counts             (:453)
+
+Here the whole loop lives inside one `shard_map`-wrapped jit: `grow_tree`
+takes `axis_name="data"` and issues `lax.psum` on root sums and on each
+smaller-child histogram; everything downstream is computed redundantly
+(and identically) on every shard, so trees stay in lockstep without any
+split broadcast — the same invariant the reference relies on
+(SURVEY §3.3). The psum payload per split is one (F, B, 3) f32 histogram,
+matching the reference's wire payload of histogram pairs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..learner.grower import GrowerSpec, TreeArrays, grow_tree
+from ..learner.split import SplitParams
+
+
+def make_mesh(devices=None, axis_name: str = "data") -> Mesh:
+    """1-D data mesh over all (or given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+class DataParallelGrower:
+    """Wraps grow_tree in shard_map over a 1-D data mesh.
+
+    Rows (the leading `nblocks` axis of the blocked bin matrix and every
+    per-row vector) are sharded over `axis_name`; per-feature vectors and
+    split params are replicated; the returned TreeArrays are replicated
+    (verified identical by construction) and row_leaf stays row-sharded.
+    """
+
+    def __init__(self, mesh: Mesh, spec: GrowerSpec, axis_name: str = "data"):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.spec = spec._replace(axis_name=axis_name)
+
+        row = P(axis_name)  # shard leading (row/block) axis
+        rep = P()
+
+        def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params):
+            tree, row_leaf = grow_tree(
+                bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+                feat_mask, params, self.spec,
+            )
+            # tree state is identical on all shards (computed from psum'd
+            # histograms); mark it replicated for the out_spec
+            tree = jax.tree.map(lambda a: jax.lax.pmean(a, axis_name) if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+            return tree, row_leaf
+
+        in_specs = (row, rep, rep, rep, rep, row, row, row, rep, rep)
+        out_specs = (jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)), row)
+        self._fn = jax.jit(
+            jax.shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    def __call__(self, bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+                 feat_mask, params: SplitParams) -> Tuple[TreeArrays, jax.Array]:
+        return self._fn(
+            bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params
+        )
+
+    def shard_inputs(self, dev: dict) -> dict:
+        """device_put the dataset arrays with the right shardings."""
+        row = NamedSharding(self.mesh, P(self.axis_name))
+        rep = NamedSharding(self.mesh, P())
+        out = dict(dev)
+        out["bins"] = jax.device_put(dev["bins"], row)
+        out["valid"] = jax.device_put(dev["valid"], row)
+        for k in ("nan_bin", "num_bins", "mono", "is_cat"):
+            out[k] = jax.device_put(dev[k], rep)
+        return out
+
+
+def _tree_arrays_structure(spec: GrowerSpec) -> TreeArrays:
+    """A dummy TreeArrays with the right pytree structure for out_specs."""
+    L = spec.num_leaves
+    z = jnp.zeros
+    return TreeArrays(
+        num_nodes=z((), jnp.int32),
+        node_feature=z(L - 1, jnp.int32), node_bin=z(L - 1, jnp.int32),
+        node_gain=z(L - 1, jnp.float32), node_default_left=z(L - 1, bool),
+        node_cat=z(L - 1, bool), node_left=z(L - 1, jnp.int32),
+        node_right=z(L - 1, jnp.int32), node_value=z(L - 1, jnp.float32),
+        node_weight=z(L - 1, jnp.float32), node_count=z(L - 1, jnp.float32),
+        leaf_value=z(L, jnp.float32), leaf_weight=z(L, jnp.float32),
+        leaf_count=z(L, jnp.float32), leaf_depth=z(L, jnp.int32),
+    )

@@ -1,0 +1,112 @@
+"""Row sampling strategies: bagging and GOSS.
+
+Reference: include/LightGBM/sample_strategy.h:23 + src/boosting/bagging.hpp
++ src/boosting/goss.hpp. The reference materializes index lists
+(bag_data_indices) via ParallelPartitionRunner; on TPU the natural form is
+a per-row {0,1} mask multiplied into the gradient channels — rows outside
+the bag contribute nothing to histograms or counts, while the partition
+step still routes them (their scores stay correct).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config
+
+
+class SampleStrategy:
+    """Produces (mask, grad, hess) per iteration."""
+
+    def __init__(self, config: Config, num_data: int):
+        self.config = config
+        self.num_data = num_data
+        self._cached_mask: Optional[jax.Array] = None
+
+    @property
+    def is_hessian_change(self) -> bool:
+        return False
+
+    def sample(
+        self, iter_num: int, grad: jax.Array, hess: jax.Array, valid: jax.Array,
+        label: Optional[jax.Array],
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Returns (mask, grad, hess); grad/hess may be rescaled (GOSS)."""
+        return valid, grad, hess
+
+
+class BaggingStrategy(SampleStrategy):
+    """bagging_fraction/bagging_freq (+ pos/neg fractions) via Bernoulli
+    masks regenerated every `bagging_freq` iterations (bagging.hpp:30)."""
+
+    def __init__(self, config: Config, num_data: int):
+        super().__init__(config, num_data)
+        c = config
+        self.use_pos_neg = (
+            c.pos_bagging_fraction < 1.0 or c.neg_bagging_fraction < 1.0
+        )
+        self.enabled = c.bagging_freq > 0 and (
+            c.bagging_fraction < 1.0 or self.use_pos_neg
+        )
+
+    def sample(self, iter_num, grad, hess, valid, label):
+        c = self.config
+        if not self.enabled:
+            return valid, grad, hess
+        if self._cached_mask is not None and iter_num % c.bagging_freq != 0:
+            return self._cached_mask, grad, hess
+        key = jax.random.key(c.bagging_seed + iter_num)
+        u = jax.random.uniform(key, valid.shape)
+        if self.use_pos_neg and label is not None:
+            frac = jnp.where(
+                label > 0, c.pos_bagging_fraction, c.neg_bagging_fraction
+            )
+        else:
+            frac = c.bagging_fraction
+        mask = (u < frac).astype(jnp.float32) * valid
+        self._cached_mask = mask
+        return mask, grad, hess
+
+
+class GOSSStrategy(SampleStrategy):
+    """Gradient one-side sampling (goss.hpp): keep the top_rate fraction by
+    |g*h|, sample other_rate of the rest and amplify their grad/hess by
+    (1-top_rate)/other_rate. No sampling during the first 1/learning_rate
+    iterations (goss.hpp:33)."""
+
+    @property
+    def is_hessian_change(self) -> bool:
+        return True
+
+    def sample(self, iter_num, grad, hess, valid, label):
+        c = self.config
+        warmup = int(1.0 / c.learning_rate) + 1
+        if iter_num < warmup:
+            return valid, grad, hess
+        w = jnp.abs(grad * hess) * valid
+        n_valid = jnp.sum(valid)
+        top_n = jnp.maximum((n_valid * c.top_rate).astype(jnp.int32), 1)
+        # threshold = top_n-th largest weight
+        sorted_w = jnp.sort(w)[::-1]
+        thr = sorted_w[jnp.minimum(top_n, w.shape[0] - 1)]
+        top_mask = w > thr
+        rest = (~top_mask) & (valid > 0)
+        key = jax.random.key(c.bagging_seed * 7919 + iter_num)
+        p_rest = c.other_rate / max(1e-12, 1.0 - c.top_rate)
+        rand_mask = jax.random.uniform(key, w.shape) < p_rest
+        sampled = rest & rand_mask
+        amp = (1.0 - c.top_rate) / max(c.other_rate, 1e-12)
+        mult = top_mask.astype(jnp.float32) + sampled.astype(jnp.float32) * amp
+        mask = (top_mask | sampled).astype(jnp.float32) * valid
+        return mask, grad * mult, hess * mult
+
+
+def create_sample_strategy(config: Config, num_data: int) -> SampleStrategy:
+    """Factory (reference sample_strategy.cpp:15)."""
+    if config.data_sample_strategy == "goss":
+        return GOSSStrategy(config, num_data)
+    return BaggingStrategy(config, num_data)

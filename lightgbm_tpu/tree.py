@@ -1,0 +1,248 @@
+"""Tree model: host representation, prediction, and device traversal.
+
+Mirrors the reference array-based Tree (include/LightGBM/tree.h:26,
+src/io/tree.cpp): internal node arrays (split_feature, threshold,
+decision_type, left/right children with <0 = ~leaf encoding) and leaf
+arrays. decision_type bit layout (tree.h:20-21):
+
+  bit 0: categorical (1) / numerical (0)
+  bit 1: default_left
+  bits 2-3: missing type (0 None, 1 Zero, 2 NaN)
+
+Thresholds are stored as real values; numerical decisions are
+`value <= threshold` -> left. Categorical decisions test membership of
+int(value) in a bitset (cat_threshold) -> left.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, List, Optional, Sequence
+
+import numpy as np
+
+from .binning import BinType, MissingType
+
+if TYPE_CHECKING:
+    from .dataset import BinnedDataset
+    from .learner.grower import TreeArrays
+
+_CAT_MASK = 1
+_DEFAULT_LEFT_MASK = 2
+
+
+def _missing_type_of(dt: int) -> int:
+    return (int(dt) >> 2) & 3
+
+
+@dataclass
+class Tree:
+    """Host-side decision tree in the reference model-file layout."""
+
+    num_leaves: int
+    shrinkage: float = 1.0
+    # internal nodes (num_leaves - 1 entries; may be 0 for a stump)
+    split_feature: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    split_gain: np.ndarray = field(default_factory=lambda: np.zeros(0, np.float64))
+    threshold: np.ndarray = field(default_factory=lambda: np.zeros(0, np.float64))
+    decision_type: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    left_child: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    right_child: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    internal_value: np.ndarray = field(default_factory=lambda: np.zeros(0, np.float64))
+    internal_weight: np.ndarray = field(default_factory=lambda: np.zeros(0, np.float64))
+    internal_count: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    # leaves
+    leaf_value: np.ndarray = field(default_factory=lambda: np.zeros(1, np.float64))
+    leaf_weight: np.ndarray = field(default_factory=lambda: np.zeros(1, np.float64))
+    leaf_count: np.ndarray = field(default_factory=lambda: np.zeros(1, np.int64))
+    # categorical bitsets (tree.h cat_boundaries_/cat_threshold_)
+    num_cat: int = 0
+    cat_boundaries: np.ndarray = field(default_factory=lambda: np.zeros(1, np.int64))
+    cat_threshold: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint32))
+    is_linear: bool = False
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_arrays(arrays: "TreeArrays", dataset: "BinnedDataset", shrinkage: float) -> "Tree":
+        """Convert device TreeArrays (used-feature indices, bin thresholds)
+        to the host model (original feature indices, real thresholds)."""
+        n_nodes = int(arrays.num_nodes)
+        num_leaves = n_nodes + 1
+        t = Tree(num_leaves=num_leaves, shrinkage=shrinkage)
+        used = dataset.used_features
+        mappers = dataset.mappers
+
+        nf = np.asarray(arrays.node_feature[:n_nodes])
+        nb = np.asarray(arrays.node_bin[:n_nodes])
+        ndl = np.asarray(arrays.node_default_left[:n_nodes])
+        ncat = np.asarray(arrays.node_cat[:n_nodes])
+
+        t.split_feature = used[nf].astype(np.int32) if n_nodes else np.zeros(0, np.int32)
+        t.split_gain = np.asarray(arrays.node_gain[:n_nodes], dtype=np.float64)
+        t.left_child = np.asarray(arrays.node_left[:n_nodes], dtype=np.int32)
+        t.right_child = np.asarray(arrays.node_right[:n_nodes], dtype=np.int32)
+        t.internal_value = np.asarray(arrays.node_value[:n_nodes], dtype=np.float64)
+        t.internal_weight = np.asarray(arrays.node_weight[:n_nodes], dtype=np.float64)
+        t.internal_count = np.asarray(
+            np.round(arrays.node_count[:n_nodes]), dtype=np.int64
+        )
+        t.leaf_value = np.asarray(arrays.leaf_value[:num_leaves], dtype=np.float64) * shrinkage
+        t.leaf_weight = np.asarray(arrays.leaf_weight[:num_leaves], dtype=np.float64)
+        t.leaf_count = np.asarray(np.round(arrays.leaf_count[:num_leaves]), dtype=np.int64)
+
+        thresholds = np.zeros(n_nodes, np.float64)
+        decision = np.zeros(n_nodes, np.int32)
+        cat_boundaries = [0]
+        cat_threshold: List[np.uint32] = []
+        n_cat = 0
+        for i in range(n_nodes):
+            m = mappers[int(t.split_feature[i])]
+            dt = 0
+            if m.missing_type == MissingType.NAN:
+                dt |= 2 << 2
+            # NOTE: MissingType.ZERO is intentionally emitted as None: the
+            # grower currently routes the zero bin numerically (by
+            # threshold), so prediction must too; the reference's
+            # zero-as-missing default-direction double scan is a pending
+            # milestone (feature_histogram.hpp:832 NA_AS_MISSING path).
+            if ncat[i]:
+                dt |= _CAT_MASK
+                # one-vs-rest: bitset holding the single left-going category
+                cat_val = int(m.categories[int(nb[i])]) if int(nb[i]) < len(m.categories) else 0
+                n_words = cat_val // 32 + 1
+                words = [0] * n_words
+                words[cat_val // 32] |= 1 << (cat_val % 32)
+                thresholds[i] = float(n_cat)  # index into cat_boundaries
+                cat_threshold.extend(np.uint32(w) for w in words)
+                cat_boundaries.append(len(cat_threshold))
+                n_cat += 1
+            else:
+                if ndl[i]:
+                    dt |= _DEFAULT_LEFT_MASK
+                thresholds[i] = m.bin_to_value(int(nb[i]))
+            decision[i] = dt
+        t.threshold = thresholds
+        t.decision_type = decision
+        t.num_cat = n_cat
+        t.cat_boundaries = np.asarray(cat_boundaries, dtype=np.int64)
+        t.cat_threshold = np.asarray(cat_threshold, dtype=np.uint32)
+        return t
+
+    # ------------------------------------------------------------------
+    def max_depth(self) -> int:
+        if self.num_leaves <= 1:
+            return 0
+        depth = np.zeros(len(self.left_child), np.int32)
+        md = 1
+        for i in range(len(self.left_child)):
+            for c in (self.left_child[i], self.right_child[i]):
+                if c >= 0:
+                    depth[c] = depth[i] + 1
+                    md = max(md, depth[c] + 1)
+                else:
+                    md = max(md, depth[i] + 1)
+        return int(md)
+
+    def _cat_in_bitset(self, node: int, values: np.ndarray) -> np.ndarray:
+        ci = int(self.threshold[node])
+        lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+        words = self.cat_threshold[lo:hi]
+        iv = values.astype(np.int64)
+        ok = (iv >= 0) & (iv < 32 * len(words)) & ~np.isnan(values)
+        ivc = np.clip(iv, 0, max(0, 32 * len(words) - 1))
+        bits = (words[ivc // 32] >> (ivc % 32).astype(np.uint32)) & 1
+        return ok & (bits == 1)
+
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized decision walk -> leaf index per row (Tree::Predict)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, np.int64)
+        cur = np.zeros(n, np.int64)  # node ids; leaves become ~leaf
+        active = np.ones(n, bool)
+        while np.any(active):
+            nodes = cur[active]
+            feat = self.split_feature[nodes]
+            x = X[active, feat]
+            dt = self.decision_type[nodes]
+            is_cat = (dt & _CAT_MASK) != 0
+            go_left = np.zeros(len(nodes), bool)
+            # numerical
+            num_idx = ~is_cat
+            if np.any(num_idx):
+                xv = x[num_idx].astype(np.float64)
+                nn = nodes[num_idx]
+                thr = self.threshold[nn]
+                mt = (dt[num_idx] >> 2) & 3
+                dl = (dt[num_idx] & _DEFAULT_LEFT_MASK) != 0
+                isna = np.isnan(xv)
+                # Zero missing: NaN and 0 treated as missing (tree.cpp Decision)
+                miss = np.where(mt == 2, isna, np.where(mt == 1, isna | (np.abs(xv) <= 1e-35), np.zeros_like(isna)))
+                xv = np.where(isna & (mt != 2), 0.0, xv)
+                gl = np.where(miss, dl, xv <= thr)
+                go_left[num_idx] = gl
+            if np.any(is_cat):
+                cn = nodes[is_cat]
+                xv = x[is_cat].astype(np.float64)
+                gl = np.zeros(len(cn), bool)
+                for u in np.unique(cn):
+                    mask = cn == u
+                    gl[mask] = self._cat_in_bitset(int(u), xv[mask])
+                go_left[is_cat] = gl
+            nxt = np.where(go_left, self.left_child[nodes], self.right_child[nodes])
+            cur[active] = nxt
+            active = cur >= 0
+        return ~cur  # leaf index
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return self.leaf_value[self.predict_leaf(X)]
+
+    def feature_importance_split(self, num_features: int) -> np.ndarray:
+        imp = np.zeros(num_features)
+        for i in range(len(self.split_feature)):
+            if self.split_gain[i] > 0:
+                imp[self.split_feature[i]] += 1
+        return imp
+
+    def feature_importance_gain(self, num_features: int) -> np.ndarray:
+        imp = np.zeros(num_features)
+        for i in range(len(self.split_feature)):
+            if self.split_gain[i] > 0:
+                imp[self.split_feature[i]] += self.split_gain[i]
+        return imp
+
+
+def traverse_tree_bins(arrays: "TreeArrays", bins_blocked, nan_bin):
+    """Device traversal of a grown tree over a BINNED matrix -> per-row leaf.
+
+    Used to score validation sets each iteration (reference
+    ScoreUpdater::AddScore via tree traversal). Iterates node-by-node like
+    the training partition: O(num_nodes) masked passes, all regular ops.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    nb_, F, Bk = bins_blocked.shape
+    N = nb_ * Bk
+    n_nodes = arrays.num_nodes
+
+    def body(k, row_node):
+        # rows sitting at internal node k move to a child
+        f = arrays.node_feature[k]
+        fbins = lax.dynamic_slice_in_dim(bins_blocked, f, 1, axis=1).reshape(N)
+        fnan = nan_bin[f]
+        go_left = jnp.where(
+            arrays.node_cat[k],
+            fbins == arrays.node_bin[k],
+            (fbins <= arrays.node_bin[k])
+            | (arrays.node_default_left[k] & (fbins == fnan) & (fnan >= 0)),
+        )
+        on = row_node == k
+        child = jnp.where(go_left, arrays.node_left[k], arrays.node_right[k])
+        return jnp.where(on & (k < n_nodes), child, row_node)
+
+    row_node = jnp.zeros(N, jnp.int32)
+    row_node = lax.fori_loop(0, arrays.node_feature.shape[0], body, row_node)
+    # all rows should now be at leaves (negative); a stump stays at node 0
+    leaf = jnp.where(row_node < 0, ~row_node, 0)
+    return leaf

@@ -1,0 +1,54 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Must set the env vars BEFORE jax is imported anywhere (the platform and
+device count are fixed at backend init).
+"""
+
+import os
+
+# force cpu: the ambient environment presets JAX_PLATFORMS=axon (one real
+# TPU behind a tunnel) — tests must run on the virtual 8-device CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+import numpy as np
+import pytest
+
+# The ambient axon plugin (sitecustomize on PYTHONPATH) force-sets
+# jax_platforms="axon,cpu" at interpreter start, overriding the env var;
+# and initializing the axon backend contacts the (exclusive) TPU tunnel.
+# Re-override at the config level so tests never touch the tunnel.
+jax.config.update("jax_platforms", "cpu")
+
+# persistent compile cache: the grower's while_loop compiles are 10-40s
+# each on CPU; cache them across test runs
+jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_comp_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def make_synthetic_regression(n=1000, n_features=10, seed=42):
+    """Small regression fixture (reference tests utils.py pattern)."""
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, n_features)
+    w = rs.randn(n_features)
+    y = X @ w + 0.1 * rs.randn(n)
+    return X, y
+
+
+def make_synthetic_binary(n=1000, n_features=10, seed=42):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, n_features)
+    w = rs.randn(n_features)
+    logits = X @ w
+    y = (logits + 0.5 * rs.randn(n) > 0).astype(np.float64)
+    return X, y

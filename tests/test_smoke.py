@@ -1,0 +1,81 @@
+"""End-to-end smoke: train, improve metric, predict, save/load round-trip."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import make_synthetic_binary, make_synthetic_regression
+
+
+def test_train_binary_improves_auc():
+    X, y = make_synthetic_binary(2000, 10)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+         "metric": "auc", "verbosity": -1, "min_data_in_leaf": 5},
+        ds,
+        num_boost_round=30,
+        valid_sets=[ds],
+        valid_names=["train"],
+    )
+    pred = bst.predict(X)
+    assert pred.shape == (2000,)
+    assert np.all((pred >= 0) & (pred <= 1))
+    from sklearn.metrics import roc_auc_score
+
+    auc = roc_auc_score(y, pred)
+    assert auc > 0.95, f"AUC too low: {auc}"
+
+
+def test_train_regression_decreases_l2():
+    X, y = make_synthetic_regression(2000, 10)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    res = {}
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "learning_rate": 0.1,
+         "metric": "l2", "verbosity": -1},
+        ds,
+        num_boost_round=50,
+        valid_sets=[ds],
+        valid_names=["train"],
+        callbacks=[lgb.record_evaluation(res)],
+    )
+    l2 = res["train"]["l2"]
+    assert l2[-1] < l2[0] * 0.2, f"l2 did not decrease enough: {l2[0]} -> {l2[-1]}"
+    # training-score predictions equal fresh predictions
+    pred = bst.predict(X)
+    mse = np.mean((pred - y) ** 2)
+    assert abs(mse - l2[-1]) < 1e-3 * max(1.0, abs(l2[-1]))
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X, y = make_synthetic_binary(500, 8)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1}, ds, num_boost_round=10
+    )
+    pred = bst.predict(X)
+    f = tmp_path / "model.txt"
+    bst.save_model(f)
+    bst2 = lgb.Booster(model_file=str(f))
+    pred2 = bst2.predict(X)
+    np.testing.assert_allclose(pred, pred2, rtol=1e-6, atol=1e-9)
+
+
+def test_early_stopping():
+    X, y = make_synthetic_binary(2000, 10)
+    Xt, yt = X[:1500], y[:1500]
+    Xv, yv = X[1500:], y[1500:]
+    dtrain = lgb.Dataset(Xt, label=yt, free_raw_data=False)
+    dvalid = lgb.Dataset(Xv, label=yv, reference=dtrain, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "learning_rate": 0.3,
+         "metric": "binary_logloss", "verbosity": -1},
+        dtrain,
+        num_boost_round=200,
+        valid_sets=[dvalid],
+        callbacks=[lgb.early_stopping(5, verbose=False)],
+    )
+    assert bst.best_iteration > 0
+    assert bst.best_iteration <= 200
