@@ -189,6 +189,7 @@ class GBDT:
                 feat_mask,
                 self.params,
                 self.spec,
+                valid=self.dev["valid"],
             )
             n_nodes = int(arrays.num_nodes)
             if n_nodes > 0:

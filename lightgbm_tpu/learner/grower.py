@@ -33,7 +33,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .histogram import leaf_histogram, masked_leaf_histogram, root_sums
+from .histogram import (
+    gather_rows,
+    hist_capacities,
+    leaf_histogram,
+    leaf_histogram_rows,
+    masked_leaf_histogram,
+    root_sums,
+)
 from .split import NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
 
 
@@ -44,6 +51,10 @@ class GrowerSpec(NamedTuple):
     num_bins: int  # uniform bin-axis size B
     max_depth: int  # <= 0 means unlimited
     axis_name: Optional[str] = None
+    # gathered smaller-child histograms: per-split cost tracks leaf size
+    # instead of N (the reference's index-list construction,
+    # data_partition.hpp); False = masked full scans (simpler, for debug)
+    gather_hist: bool = True
 
 
 class TreeArrays(NamedTuple):
@@ -141,13 +152,21 @@ def grow_tree(
     feat_mask: jax.Array,  # (F,) bool — per-tree feature_fraction sample
     params: SplitParams,
     spec: GrowerSpec,
+    valid: Optional[jax.Array] = None,  # (N,) f32 — 1 for real rows; None = all
 ) -> Tuple[TreeArrays, jax.Array]:
-    """Grow one tree; returns (tree arrays, per-row leaf assignment)."""
+    """Grow one tree; returns (tree arrays, per-row leaf assignment).
+
+    Padding rows (valid == 0) carry leaf id -1 so they never join a leaf
+    or occupy gather capacity; out-of-bag rows (mask 0 but valid 1) are
+    partitioned normally for score updates but contribute zero to
+    histograms via their zeroed gh channels.
+    """
     L = spec.num_leaves
     B = spec.num_bins
     nb, F, Bk = bins_blocked.shape
     N = nb * Bk
     ax = spec.axis_name
+    caps = hist_capacities(N)
 
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1)  # (N, 3)
     root = root_sums(gh, ax)
@@ -178,9 +197,14 @@ def grow_tree(
         leaf_depth=jnp.zeros(L, jnp.int32),
     )
 
+    row_leaf0 = (
+        jnp.zeros(N, jnp.int32)
+        if valid is None
+        else jnp.where(valid > 0, 0, -1).astype(jnp.int32)
+    )
     state = _State(
         i=jnp.int32(0),
-        row_leaf=jnp.zeros(N, jnp.int32),
+        row_leaf=row_leaf0,
         hist=hist,
         leaf_g=jnp.zeros(L, jnp.float32).at[0].set(root[0]),
         leaf_h=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
@@ -247,11 +271,49 @@ def grow_tree(
         on_leaf = s.row_leaf == l
         row_leaf = jnp.where(on_leaf & ~go_left, new, s.row_leaf)
 
-        # ---- child histograms: smaller by masked scan, larger by subtraction
+        # ---- child histograms: smaller by gather/scan, larger by subtraction
         parent_hist = s.hist[l]
-        left_smaller = rec.left_c <= rec.right_c
+        # choose the smaller child by ACTUAL partition counts (incl.
+        # out-of-bag rows, which occupy gather capacity). The choice must
+        # be GLOBAL when distributed — every shard must scan the same
+        # child or the psum mixes left/right histograms.
+        n_on_leaf = jnp.sum(on_leaf)
+        n_left = jnp.sum(on_leaf & go_left)
+        n_right = n_on_leaf - n_left
+        if ax is not None:
+            left_smaller = lax.psum(n_left, ax) <= lax.psum(n_right, ax)
+        else:
+            left_smaller = n_left <= n_right
         small_id = jnp.where(left_smaller, l, new)
-        small_hist = masked_leaf_histogram(bins_blocked, gh, row_leaf, small_id, B)
+        if spec.gather_hist:
+            on_small = row_leaf == small_id
+            # local row count of the globally-chosen child (may exceed N/2
+            # on a skewed shard -> full-size fallback bucket)
+            cnt_small = jnp.where(left_smaller, n_left, n_right)
+
+            def mk_branch(cap: int):
+                def branch(_):
+                    idx = jnp.nonzero(on_small, size=cap, fill_value=N)[0]
+                    bb = gather_rows(bins_blocked, idx)  # (cap, F)
+                    gg = jnp.take(gh, idx, axis=0, mode="fill", fill_value=0.0)
+                    return leaf_histogram_rows(bb, gg, B)
+
+                return branch
+
+            # smallest capacity >= cnt_small (caps are descending)
+            caps_arr = jnp.asarray(caps, jnp.int32)
+            bidx = jnp.clip(
+                jnp.sum(caps_arr >= cnt_small) - 1, 0, len(caps) - 1
+            )
+            branches = [mk_branch(c) for c in caps]
+            if ax is not None:
+                # skewed shard: the globally-smaller child can exceed N/2
+                # locally -> full-size fallback
+                branches.append(mk_branch(N))
+                bidx = jnp.where(cnt_small > caps[0], len(caps), bidx)
+            small_hist = lax.switch(bidx, branches, None)
+        else:
+            small_hist = masked_leaf_histogram(bins_blocked, gh, row_leaf, small_id, B)
         if ax is not None:
             small_hist = lax.psum(small_hist, ax)
         large_hist = parent_hist - small_hist

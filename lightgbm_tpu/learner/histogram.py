@@ -24,16 +24,14 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def leaf_histogram(
-    bins_blocked: jax.Array,  # (nblocks, F, Bk) int32 — feature-major row blocks
-    gh: jax.Array,  # (N, 3) float32 — (grad, hess, count) already masked to the leaf
-    num_bins: int,  # uniform bin-axis size B
+def _hist_scan(
+    bins_fb: jax.Array,  # (nblocks, F, Bk) int — feature-major row blocks
+    gh_b: jax.Array,  # (nblocks, Bk, 3) f32
+    num_bins: int,
 ) -> jax.Array:
-    """Return (F, B, 3) histogram of the rows whose gh mask is nonzero."""
-    nblocks, F, Bk = bins_blocked.shape
-    gh_blocked = gh.reshape(nblocks, Bk, 3)
-
-    iota = jnp.arange(num_bins, dtype=bins_blocked.dtype)
+    """Shared one-hot-matmul accumulation body: (F, B, 3) f32."""
+    nblocks, F, Bk = bins_fb.shape
+    iota = jnp.arange(num_bins, dtype=bins_fb.dtype)
 
     def body(acc, xs):
         b, g = xs  # (F, Bk) int, (Bk, 3) f32
@@ -44,8 +42,72 @@ def leaf_histogram(
         return acc, None
 
     init = jnp.zeros((F, num_bins, 3), dtype=jnp.float32)
-    hist, _ = lax.scan(body, init, (bins_blocked, gh_blocked))
+    hist, _ = lax.scan(body, init, (bins_fb, gh_b))
     return hist
+
+
+def leaf_histogram(
+    bins_blocked: jax.Array,  # (nblocks, F, Bk) int32 — feature-major row blocks
+    gh: jax.Array,  # (N, 3) float32 — (grad, hess, count) already masked to the leaf
+    num_bins: int,  # uniform bin-axis size B
+) -> jax.Array:
+    """Return (F, B, 3) histogram of the rows whose gh mask is nonzero."""
+    nblocks, F, Bk = bins_blocked.shape
+    return _hist_scan(bins_blocked, gh.reshape(nblocks, Bk, 3), num_bins)
+
+
+def leaf_histogram_rows(
+    bins_rows: jax.Array,  # (R, F) int32 — gathered rows, row-major
+    gh_rows: jax.Array,  # (R, 3) f32
+    num_bins: int,
+    block: int = 512,
+) -> jax.Array:
+    """Histogram over a gathered row subset (row-major layout).
+
+    Same one-hot-matmul formulation as `leaf_histogram`, but over a
+    compacted buffer whose size is a power-of-two fraction of N — the
+    TPU analog of the reference constructing histograms only over the
+    leaf's index list (data_partition.hpp + dense_bin.hpp:99 loops over
+    data_indices)."""
+    R, F = bins_rows.shape
+    if R % block != 0:
+        # pad to a block multiple (zero gh -> no contribution); keeps the
+        # scan tiled even for odd-sized fallback buffers
+        pad = block - R % block
+        bins_rows = jnp.pad(bins_rows, ((0, pad), (0, 0)))
+        gh_rows = jnp.pad(gh_rows, ((0, pad), (0, 0)))
+        R += pad
+    nb = R // block
+    bb = bins_rows.reshape(nb, block, F).transpose(0, 2, 1)  # (nb, F, block)
+    gg = gh_rows.reshape(nb, block, 3)
+    return _hist_scan(bb, gg, num_bins)
+
+
+def gather_rows(bins_blocked: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather rows by flat index from the blocked (nblocks, F, Bk) layout
+    -> (len(idx), F). Out-of-range idx (pad slots) clamp; callers zero
+    their gh so clamped rows contribute nothing."""
+    nb, F, Bk = bins_blocked.shape
+    blk = jnp.clip(idx // Bk, 0, nb - 1)
+    off = idx % Bk
+    return bins_blocked[blk, :, off]
+
+
+def hist_capacities(n_rows: int, min_cap: int = 1024) -> tuple:
+    """Static ladder of gather-buffer sizes: N/2, N/4, ... >= min_cap.
+    The smaller child always fits in N/2; deep (small) leaves use the
+    small buffers so histogram cost tracks leaf size."""
+    def _round(c: int) -> int:
+        return ((c + 511) // 512) * 512
+
+    caps = []
+    c = n_rows // 2
+    while c >= min_cap:
+        caps.append(_round(c))
+        c //= 2
+    if not caps:
+        caps.append(_round(max(n_rows // 2, 1)))
+    return tuple(caps)
 
 
 def masked_leaf_histogram(

@@ -53,17 +53,17 @@ class DataParallelGrower:
         row = P(axis_name)  # shard leading (row/block) axis
         rep = P()
 
-        def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params):
+        def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params, valid):
             tree, row_leaf = grow_tree(
                 bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-                feat_mask, params, self.spec,
+                feat_mask, params, self.spec, valid=valid,
             )
             # tree state is identical on all shards (computed from psum'd
             # histograms); mark it replicated for the out_spec
             tree = jax.tree.map(lambda a: jax.lax.pmean(a, axis_name) if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
             return tree, row_leaf
 
-        in_specs = (row, rep, rep, rep, rep, row, row, row, rep, rep)
+        in_specs = (row, rep, rep, rep, rep, row, row, row, rep, rep, row)
         out_specs = (jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)), row)
         self._fn = jax.jit(
             jax.shard_map(
@@ -76,9 +76,10 @@ class DataParallelGrower:
         )
 
     def __call__(self, bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-                 feat_mask, params: SplitParams) -> Tuple[TreeArrays, jax.Array]:
+                 feat_mask, params: SplitParams, valid) -> Tuple[TreeArrays, jax.Array]:
         return self._fn(
-            bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params
+            bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask,
+            params, valid,
         )
 
     def shard_inputs(self, dev: dict) -> dict:
