@@ -24,6 +24,8 @@ from .callback import early_stopping, log_evaluation, record_evaluation, reset_p
 from .engine import CVBooster, cv, train
 from .log import register_logger
 
+from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -37,5 +39,9 @@ __all__ = [
     "record_evaluation",
     "reset_parameter",
     "register_logger",
+    "LGBMModel",
+    "LGBMClassifier",
+    "LGBMRegressor",
+    "LGBMRanker",
     "__version__",
 ]

@@ -368,11 +368,11 @@ class Booster:
     def _run_feval(self, feval, data_idx: int, name: str):
         ds = self.train_set if data_idx == 0 else self._valid_sets[data_idx - 1]
         preds = self.__inner_predict_raw(data_idx)
-        res = feval(preds, ds)
-        if isinstance(res, list):
-            results = res
-        else:
-            results = [res]
+        fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+        results = []
+        for f in fevals:
+            res = f(preds, ds)
+            results.extend(res if isinstance(res, list) else [res])
         return [(name, rn, rv, rhb) for rn, rv, rhb in results]
 
     # ------------------------------------------------------------------

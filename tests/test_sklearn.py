@@ -1,0 +1,145 @@
+"""sklearn-estimator tests (reference tests/python_package_test/test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+from conftest import make_synthetic_binary, make_synthetic_regression
+
+import lightgbm_tpu as lgb
+
+
+def test_regressor_basic():
+    X, y = make_synthetic_regression(n=600, n_features=8)
+    model = lgb.LGBMRegressor(n_estimators=20, num_leaves=15, verbosity=-1)
+    model.fit(X, y)
+    pred = model.predict(X)
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < np.var(y) * 0.5
+    assert model.n_features_ == 8
+    assert len(model.feature_importances_) == 8
+    assert model.feature_importances_.sum() > 0
+
+
+def test_classifier_binary():
+    X, y = make_synthetic_binary(n=600, n_features=8)
+    model = lgb.LGBMClassifier(n_estimators=20, num_leaves=15, verbosity=-1)
+    model.fit(X, y)
+    proba = model.predict_proba(X)
+    assert proba.shape == (600, 2)
+    assert np.allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    pred = model.predict(X)
+    acc = float(np.mean(pred == y))
+    assert acc > 0.85
+    assert set(model.classes_) == {0.0, 1.0}
+    assert model.n_classes_ == 2
+
+
+def test_classifier_multiclass():
+    rs = np.random.RandomState(3)
+    X = rs.randn(600, 6)
+    y = np.argmax(X[:, :3] + 0.3 * rs.randn(600, 3), axis=1)
+    model = lgb.LGBMClassifier(n_estimators=15, num_leaves=7, verbosity=-1)
+    model.fit(X, y)
+    assert model.n_classes_ == 3
+    proba = model.predict_proba(X)
+    assert proba.shape == (600, 3)
+    acc = float(np.mean(model.predict(X) == y))
+    assert acc > 0.8
+
+
+def test_classifier_string_labels():
+    X, y = make_synthetic_binary(n=400, n_features=6)
+    labels = np.where(y > 0, "pos", "neg")
+    model = lgb.LGBMClassifier(n_estimators=10, num_leaves=7, verbosity=-1)
+    model.fit(X, labels)
+    pred = model.predict(X)
+    assert set(np.unique(pred)) <= {"pos", "neg"}
+    acc = float(np.mean(pred == labels))
+    assert acc > 0.8
+
+
+def test_early_stopping_via_eval_set():
+    X, y = make_synthetic_regression(n=800, n_features=8)
+    Xt, yt = X[:600], y[:600]
+    Xv, yv = X[600:], y[600:]
+    model = lgb.LGBMRegressor(n_estimators=100, num_leaves=15, verbosity=-1)
+    model.fit(
+        Xt, yt,
+        eval_set=[(Xv, yv)],
+        callbacks=[lgb.early_stopping(5, verbose=False)],
+    )
+    assert model.best_iteration_ > 0
+    assert "valid_0" in model.evals_result_
+    assert "l2" in model.evals_result_["valid_0"]
+
+
+def test_ranker():
+    rs = np.random.RandomState(7)
+    n, q = 500, 25
+    X = rs.randn(n, 6)
+    rel = np.clip((X[:, 0] * 2 + rs.randn(n)).astype(int) % 4, 0, 3)
+    group = np.full(q, n // q)
+    model = lgb.LGBMRanker(n_estimators=10, num_leaves=7, verbosity=-1)
+    model.fit(X, rel, group=group)
+    pred = model.predict(X)
+    assert pred.shape == (n,)
+    # scores should correlate with relevance
+    assert np.corrcoef(pred, rel)[0, 1] > 0.3
+
+
+def test_ranker_requires_group():
+    X, y = make_synthetic_regression(n=100, n_features=4)
+    model = lgb.LGBMRanker(n_estimators=5)
+    with pytest.raises(ValueError):
+        model.fit(X, y)
+
+
+def test_custom_objective_callable():
+    X, y = make_synthetic_regression(n=400, n_features=6)
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    model = lgb.LGBMRegressor(n_estimators=15, num_leaves=15, objective=l2_obj, verbosity=-1)
+    model.fit(X, y)
+    pred = model.predict(X)
+    # raw score (no convert): still should fit the data
+    assert float(np.mean((pred - y) ** 2)) < np.var(y) * 0.6
+
+
+def test_custom_eval_metric():
+    X, y = make_synthetic_binary(n=400, n_features=6)
+
+    def my_err(y_true, y_pred):
+        p = 1.0 / (1.0 + np.exp(-y_pred))
+        return "my_err", float(np.mean((p > 0.5) != y_true)), False
+
+    model = lgb.LGBMClassifier(n_estimators=10, num_leaves=7, verbosity=-1)
+    model.fit(X, y, eval_set=[(X, y)], eval_metric=my_err)
+    assert "my_err" in model.evals_result_["valid_0"]
+
+
+def test_sklearn_param_mapping():
+    X, y = make_synthetic_regression(n=300, n_features=6)
+    model = lgb.LGBMRegressor(
+        n_estimators=5, reg_alpha=0.1, reg_lambda=0.2, min_child_samples=5,
+        subsample=0.8, subsample_freq=1, colsample_bytree=0.8, random_state=11,
+    )
+    model.fit(X, y)
+    cfg = model.booster_.config
+    assert cfg.lambda_l1 == pytest.approx(0.1)
+    assert cfg.lambda_l2 == pytest.approx(0.2)
+    assert cfg.min_data_in_leaf == 5
+    assert cfg.bagging_fraction == pytest.approx(0.8)
+    assert cfg.feature_fraction == pytest.approx(0.8)
+
+
+def test_clone_and_get_params():
+    from sklearn.base import clone
+
+    model = lgb.LGBMRegressor(n_estimators=7, num_leaves=9, custom_thing=3)
+    params = model.get_params()
+    assert params["n_estimators"] == 7
+    assert params["custom_thing"] == 3
+    m2 = clone(model)
+    assert m2.get_params()["num_leaves"] == 9
