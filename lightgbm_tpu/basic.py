@@ -280,7 +280,9 @@ class Booster:
             train_set.params = {**train_set.params, **self.params}
             train_set.construct()
             self.config = Config(train_set.params)
-            self._gbdt = GBDT(self.config, train_set._binned)
+            from .boosting import create_boosting
+
+            self._gbdt = create_boosting(self.config, train_set._binned)
             self.train_set = train_set
             self._valid_sets: List[Dataset] = []
             self._name_valid_sets: List[str] = []
@@ -315,6 +317,10 @@ class Booster:
             raise LightGBMError("Resetting train_set is not supported")
         if fobj is None:
             return self._gbdt.train_one_iter()
+        # DART applies its dropout lazily before the score is read
+        # (reference GetTrainingScore, dart.hpp:80)
+        if hasattr(self._gbdt, "before_gradients"):
+            self._gbdt.before_gradients()
         grad, hess = fobj(self.__inner_predict_raw(0), self.train_set)
         return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
 
@@ -368,6 +374,11 @@ class Booster:
     def _run_feval(self, feval, data_idx: int, name: str):
         ds = self.train_set if data_idx == 0 else self._valid_sets[data_idx - 1]
         preds = self.__inner_predict_raw(data_idx)
+        # the reference converts scores before handing them to feval
+        # (GetPredictAt -> ConvertOutput, gbdt.cpp:709); custom-objective
+        # training has objective none -> identity
+        if self._gbdt.objective is not None:
+            preds = self._gbdt.objective.convert_output(preds)
         fevals = feval if isinstance(feval, (list, tuple)) else [feval]
         results = []
         for f in fevals:

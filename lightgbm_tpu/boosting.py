@@ -59,6 +59,7 @@ class GBDT:
         self.objective: Optional[ObjectiveFunction] = create_objective(config)
         self.num_class = config.num_model_per_iteration
         self.shrinkage_rate = config.learning_rate
+        self.average_output = False  # RF mode divides prediction by #iters
         self.models: List[Tree] = []  # flat, iteration-major (models_[it*K + k])
         self.device_trees: List[Tuple[TreeArrays, Any]] = []  # (arrays w/ final leaf values, None)
         self.iter_ = 0
@@ -215,7 +216,14 @@ class GBDT:
                         add_score(vs.score[k], leaf, final_leaf, one)
                     )
                 if abs(init_scores[k]) > 1e-15:
-                    tree.leaf_value = tree.leaf_value + init_scores[k]  # AddBias
+                    # AddBias: the stored tree (host AND device) carries the
+                    # boost-from-average bias; the score got it separately at
+                    # BoostFromAverage, so score == sum(stored trees) exactly
+                    # (matters for DART drops, gbdt.cpp:424-426)
+                    tree.leaf_value = tree.leaf_value + init_scores[k]
+                    arrays = arrays._replace(
+                        leaf_value=arrays.leaf_value + init_scores[k]
+                    )
                 self.device_trees.append((arrays, None))
                 self.models.append(tree)
             else:
@@ -264,9 +272,12 @@ class GBDT:
         m[chosen] = True
         return jnp.asarray(m)
 
-    def _renew_tree_output(self, arrays: TreeArrays, row_leaf, k: int, mask) -> TreeArrays:
+    def _renew_tree_output(
+        self, arrays: TreeArrays, row_leaf, k: int, mask, resid=None
+    ) -> TreeArrays:
         """Percentile leaf refit for l1/huber/quantile/mape
-        (RegressionL1loss::RenewTreeOutput)."""
+        (RegressionL1loss::RenewTreeOutput). RF passes its own residuals
+        (label - init score, rf.hpp residual_getter)."""
         import jax.numpy as jnp
 
         ds = self.train_set
@@ -274,8 +285,9 @@ class GBDT:
         rl = np.asarray(row_leaf)[:n]
         bag = np.asarray(mask)[:n] > 0
         label = np.asarray(ds.metadata.label, dtype=np.float64)
-        score = np.asarray(self.train.score[k])[:n].astype(np.float64)
-        resid = label - score
+        if resid is None:
+            score = np.asarray(self.train.score[k])[:n].astype(np.float64)
+            resid = label - score
         w = (
             np.asarray(ds.metadata.weight, dtype=np.float64)
             if ds.metadata.weight is not None
@@ -370,6 +382,8 @@ class GBDT:
         for it in range(start_iteration, end):
             for k in range(K):
                 out[k] += self.models[it * K + k].predict(X)
+        if self.average_output and end > start_iteration:
+            out /= end - start_iteration
         return out
 
     def predict(self, X, start_iteration=0, num_iteration=-1, raw_score=False):
@@ -402,3 +416,266 @@ class GBDT:
             else:
                 imp += t.feature_importance_split(nf)
         return imp
+
+
+# ======================================================================
+class DART(GBDT):
+    """DART: Dropouts meet Multiple Additive Regression Trees
+    (reference src/boosting/dart.hpp:23).
+
+    Before each iteration a random subset of past iterations is dropped:
+    their score contributions are removed so gradients see the reduced
+    ensemble, the new tree is trained with shrinkage lr/(1+k), and the
+    dropped trees are permanently renormalized by k/(k+1) (xgboost mode:
+    lr/(lr+k) and k/(lr+k)) — dart.hpp DroppingTrees/Normalize.
+    """
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset]):
+        super().__init__(config, train_set)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self._tree_weight: List[float] = []  # per-iteration weights
+        self._sum_weight = 0.0
+        self._pending_drops: Optional[List[int]] = None
+
+    def _tree_score_delta(self, ss: _ScoreSet, arrays: TreeArrays, k: int, scale: float):
+        """score[k] += scale * tree(arrays) over dataset ss."""
+        import jax.numpy as jnp
+
+        dev = ss.dataset.device_arrays()
+        leaf = self._traverse(arrays, dev["bins"], dev["nan_bin"])
+        ss.score = ss.score.at[k].set(
+            add_score(ss.score[k], leaf, arrays.leaf_value, jnp.float32(scale))
+        )
+
+    def _select_drops(self) -> List[int]:
+        c = self.config
+        if self._drop_rng.rand() < c.skip_drop or self.iter_ == 0:
+            return []
+        drops: List[int] = []
+        if not c.uniform_drop:
+            inv_avg = len(self._tree_weight) / max(self._sum_weight, 1e-300)
+            rate = c.drop_rate
+            if c.max_drop > 0:
+                rate = min(rate, c.max_drop * inv_avg / max(self._sum_weight, 1e-300))
+            for i in range(self.iter_):
+                if self._drop_rng.rand() < rate * self._tree_weight[i] * inv_avg:
+                    drops.append(i)
+                    if len(drops) >= c.max_drop > 0:
+                        break
+        else:
+            rate = c.drop_rate
+            if c.max_drop > 0:
+                rate = min(rate, c.max_drop / max(1, self.iter_))
+            for i in range(self.iter_):
+                if self._drop_rng.rand() < rate:
+                    drops.append(i)
+                    if len(drops) >= c.max_drop > 0:
+                        break
+        return drops
+
+    def before_gradients(self) -> None:
+        """Apply the per-iteration dropout to the train score (the
+        reference does this lazily in GetTrainingScore, dart.hpp:80-86,
+        so custom-objective gradients also see the dropped ensemble).
+        Idempotent within one iteration."""
+        if self._pending_drops is not None:
+            return
+        c = self.config
+        K = self.num_class
+        drops = self._select_drops()
+        k_drop = float(len(drops))
+
+        # drop: remove contributions from the TRAIN score only (valid
+        # scores are corrected during normalize, dart.hpp Normalize)
+        for i in drops:
+            for k in range(K):
+                arrays, _ = self.device_trees[i * K + k]
+                if int(arrays.num_nodes) > 0:
+                    self._tree_score_delta(self.train, arrays, k, -1.0)
+
+        if not c.xgboost_dart_mode:
+            self.shrinkage_rate = c.learning_rate / (1.0 + k_drop)
+        else:
+            self.shrinkage_rate = (
+                c.learning_rate if not drops
+                else c.learning_rate / (c.learning_rate + k_drop)
+            )
+        self._pending_drops = drops
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        c = self.config
+        K = self.num_class
+        self.before_gradients()
+        drops = self._pending_drops or []
+        self._pending_drops = None
+        k_drop = float(len(drops))
+
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            # aborted: restore the dropped trees so the train score again
+            # matches the stored ensemble
+            for i in drops:
+                for k in range(K):
+                    arrays, _ = self.device_trees[i * K + k]
+                    if int(arrays.num_nodes) > 0:
+                        self._tree_score_delta(self.train, arrays, k, 1.0)
+            return ret
+
+        # normalize dropped trees: permanent weight factor + score fixes
+        if drops:
+            if not c.xgboost_dart_mode:
+                factor = k_drop / (k_drop + 1.0)  # new_weight = w * factor
+                valid_delta = -1.0 / (k_drop + 1.0)  # valid: w -> w*factor
+            else:
+                factor = k_drop / (k_drop + c.learning_rate)
+                valid_delta = -c.learning_rate / (k_drop + c.learning_rate)
+            for i in drops:
+                for k in range(K):
+                    arrays, aux = self.device_trees[i * K + k]
+                    if int(arrays.num_nodes) == 0:
+                        continue
+                    for vs in self.valids:
+                        self._tree_score_delta(vs, arrays, k, valid_delta)
+                    # train score currently lacks the tree entirely
+                    self._tree_score_delta(self.train, arrays, k, factor)
+                    new_arrays = arrays._replace(leaf_value=arrays.leaf_value * factor)
+                    self.device_trees[i * K + k] = (new_arrays, aux)
+                    self.models[i * K + k].leaf_value = (
+                        self.models[i * K + k].leaf_value * factor
+                    )
+                    self.models[i * K + k].shrinkage *= factor
+                if not c.uniform_drop:
+                    if not c.xgboost_dart_mode:
+                        self._sum_weight -= self._tree_weight[i] / (k_drop + 1.0)
+                    else:
+                        self._sum_weight -= self._tree_weight[i] / (k_drop + c.learning_rate)
+                    self._tree_weight[i] *= factor
+        if not c.uniform_drop:
+            self._tree_weight.append(self.shrinkage_rate)
+            self._sum_weight += self.shrinkage_rate
+        return False
+
+
+# ======================================================================
+class RF(GBDT):
+    """Random-forest mode (reference src/boosting/rf.hpp:25): no
+    shrinkage, gradients computed once from the constant init score,
+    prediction is the average over trees (average_output)."""
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset]):
+        c = config
+        if train_set is not None:
+            if c.data_sample_strategy == "bagging":
+                bag_ok = c.bagging_freq > 0 and 0.0 < c.bagging_fraction < 1.0
+                feat_ok = 0.0 < c.feature_fraction < 1.0
+                if not (bag_ok or feat_ok):
+                    log.fatal(
+                        "RF mode requires bagging (bagging_freq>0, bagging_fraction in (0,1)) "
+                        "or feature_fraction in (0,1)"
+                    )
+        super().__init__(config, train_set)
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        if train_set is None:
+            return
+        if self.objective is None:
+            log.fatal("RF mode does not support custom objective functions")
+        # boosting one time: constant init score -> fixed gradients (rf.hpp Boosting)
+        import jax.numpy as jnp
+
+        K = self.num_class
+        npad = train_set.num_rows_padded()
+        self._rf_init_scores = [
+            (self.objective.boost_from_score(k) if c.boost_from_average else 0.0)
+            for k in range(K)
+        ]
+        const = jnp.asarray(
+            np.repeat(np.asarray(self._rf_init_scores, np.float32)[:, None], npad, axis=1)
+        )
+        score = const if K > 1 else const[0]
+        g, h = self.objective.get_gradients(score)
+        self._rf_grad = jnp.reshape(g, (K, -1)).astype(jnp.float32)
+        self._rf_hess = jnp.reshape(h, (K, -1)).astype(jnp.float32)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        import jax.numpy as jnp
+
+        if grad is not None or hess is not None:
+            log.fatal("RF mode does not support custom objective functions")
+        K = self.num_class
+        ds = self.train_set
+        m = float(self.iter_)  # trees already averaged into the score
+        for k in range(K):
+            gk, hk = self._rf_grad[k], self._rf_hess[k]
+            mask, gk, hk = self.strategy.sample(
+                self.iter_, gk, hk, self.dev["valid"], self._label_dev
+            )
+            feat_mask = self._sample_features()
+            arrays, row_leaf = grow_tree(
+                self.dev["bins"], self.dev["nan_bin"], self.dev["num_bins"],
+                self.dev["mono"], self.dev["is_cat"], gk, hk, mask, feat_mask,
+                self.params, self.spec, valid=self.dev["valid"],
+            )
+            n_nodes = int(arrays.num_nodes)
+            init_k = self._rf_init_scores[k]
+            if n_nodes > 0:
+                if self.objective is not None and self.objective.is_renew_tree_output:
+                    label = np.asarray(ds.metadata.label, dtype=np.float64)
+                    arrays = self._renew_tree_output(
+                        arrays, row_leaf, k, mask, resid=label - init_k
+                    )
+                tree = Tree.from_arrays(arrays, ds, 1.0)
+                # AddBias: each tree is a standalone predictor incl. init
+                tree.leaf_value = tree.leaf_value + init_k
+                arrays = arrays._replace(leaf_value=arrays.leaf_value + init_k)
+            else:
+                tree = Tree(num_leaves=1, shrinkage=1.0)
+                tree.leaf_value = np.array([init_k], np.float64)
+                arrays = arrays._replace(
+                    leaf_value=arrays.leaf_value.at[0].set(init_k)
+                )
+            # running average: score = (score*m + tree)/(m+1)  (rf.hpp
+            # MultiplyScore/UpdateScore/MultiplyScore sequence)
+            sc = self.train.score[k] * m
+            sc = add_score(sc, row_leaf, arrays.leaf_value, jnp.float32(1.0))
+            self.train.score = self.train.score.at[k].set(sc / (m + 1.0))
+            for vs in self.valids:
+                vdev = vs.dataset.device_arrays()
+                leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                vsc = vs.score[k] * m
+                vsc = add_score(vsc, leaf, arrays.leaf_value, jnp.float32(1.0))
+                vs.score = vs.score.at[k].set(vsc / (m + 1.0))
+            self.models.append(tree)
+            self.device_trees.append((arrays, None))
+        self.iter_ += 1
+        return False
+
+    def rollback_one_iter(self) -> None:
+        if self.iter_ <= 0:
+            return
+        K = self.num_class
+        m = float(self.iter_)
+        for k in reversed(range(K)):
+            self.models.pop()
+            arrays, _ = self.device_trees.pop()
+            leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"])
+            sc = self.train.score[k] * m - arrays.leaf_value[leaf]
+            self.train.score = self.train.score.at[k].set(sc / (m - 1.0) if m > 1 else sc * 0)
+            for vs in self.valids:
+                vdev = vs.dataset.device_arrays()
+                vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                vsc = vs.score[k] * m - arrays.leaf_value[vleaf]
+                vs.score = vs.score.at[k].set(vsc / (m - 1.0) if m > 1 else vsc * 0)
+        self.iter_ -= 1
+
+
+def create_boosting(config: Config, train_set: Optional[BinnedDataset]) -> GBDT:
+    """Boosting factory (reference src/boosting/boosting.cpp:40)."""
+    b = config.boosting
+    if b == "gbdt":
+        return GBDT(config, train_set)
+    if b == "dart":
+        return DART(config, train_set)
+    if b == "rf":
+        return RF(config, train_set)
+    log.fatal(f"Unknown boosting type {b}")

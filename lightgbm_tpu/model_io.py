@@ -105,6 +105,8 @@ def save_model_string(
     if mc:
         buf.write("monotone_constraints=" + " ".join(str(int(v)) for v in mc) + "\n")
     buf.write("feature_infos=" + " ".join(feature_infos) + "\n")
+    if gbdt.average_output:
+        buf.write("average_output\n")
 
     total_iteration = len(gbdt.models) // K
     start_iteration = max(0, min(start_iteration, total_iteration))
@@ -192,7 +194,9 @@ def load_model_string(model_str: str) -> Tuple[Config, GBDT]:
         line = lines[i].strip()
         if line.startswith("Tree="):
             break
-        if "=" in line:
+        if line == "average_output":
+            header["average_output"] = "1"
+        elif "=" in line:
             k, v = line.split("=", 1)
             header[k.strip()] = v
         i += 1
@@ -214,6 +218,7 @@ def load_model_string(model_str: str) -> Tuple[Config, GBDT]:
     cfg = Config(params)
     gbdt = GBDT(cfg, None)
     gbdt.num_class = int(header.get("num_tree_per_iteration", "1"))
+    gbdt.average_output = header.get("average_output") == "1"
     gbdt.feature_names = header.get("feature_names", "").split(" ") if header.get("feature_names") else []
     gbdt.feature_infos_ = header.get("feature_infos", "").split(" ") if header.get("feature_infos") else []
 

@@ -227,7 +227,19 @@ class LGBMModel(_LGBMModelBase):
             str_metrics = [m for m in metrics if isinstance(m, str)]
             call_metrics = [m for m in metrics if callable(m)]
             if str_metrics:
-                params["metric"] = str_metrics
+                # merge with the existing/default metric rather than replace
+                # (reference sklearn.py:944 prepends eval metrics)
+                original = params.get("metric")
+                if original is None:
+                    # objective-implied default metric stays evaluated
+                    from .metrics import _DEFAULT_METRIC
+
+                    obj = params.get("objective")
+                    original = [_DEFAULT_METRIC[obj]] if obj in _DEFAULT_METRIC else []
+                elif isinstance(original, str):
+                    original = [original]
+                merged = list(dict.fromkeys(str_metrics + list(original)))
+                params["metric"] = merged
             feval_list = [_EvalFunctionWrapper(m) for m in call_metrics]
 
         y_arr = np.asarray(y).reshape(-1)

@@ -1,0 +1,142 @@
+"""DART and RF boosting-mode tests (reference test_engine.py dart/rf cases)."""
+
+import numpy as np
+import pytest
+
+from conftest import make_synthetic_binary, make_synthetic_regression
+
+import lightgbm_tpu as lgb
+
+
+def test_dart_trains_and_improves():
+    X, y = make_synthetic_regression(n=600, n_features=8)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = {
+        "objective": "regression", "boosting": "dart", "num_leaves": 15,
+        "drop_rate": 0.5, "skip_drop": 0.3, "verbosity": -1, "metric": "l2",
+    }
+    res = {}
+    bst = lgb.train(
+        params, ds, num_boost_round=30, valid_sets=[ds], valid_names=["t"],
+        callbacks=[lgb.record_evaluation(res)],
+    )
+    l2 = res["t"]["l2"]
+    # dropout slows convergence vs plain gbdt; just require steady progress
+    assert l2[-1] < l2[0] * 0.75
+    pred = bst.predict(X)
+    assert float(np.mean((pred - y) ** 2)) == pytest.approx(l2[-1], rel=1e-4)
+
+
+def test_dart_score_consistency():
+    """After training, internal train score must equal prediction from the
+    saved (renormalized) trees — the DART normalize bookkeeping check."""
+    X, y = make_synthetic_regression(n=400, n_features=6)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = {
+        "objective": "regression", "boosting": "dart", "num_leaves": 7,
+        "drop_rate": 0.6, "skip_drop": 0.0, "max_drop": 3, "verbosity": -1,
+        "boost_from_average": False,
+    }
+    bst = lgb.train(params, ds, num_boost_round=12)
+    internal = bst._gbdt.get_score(bst._gbdt.train)[0]
+    from_trees = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(internal, from_trees, rtol=2e-4, atol=2e-5)
+
+
+def test_dart_xgboost_mode():
+    X, y = make_synthetic_binary(n=400, n_features=6)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = {
+        "objective": "binary", "boosting": "dart", "num_leaves": 7,
+        "xgboost_dart_mode": True, "drop_rate": 0.5, "skip_drop": 0.0,
+        "verbosity": -1,
+    }
+    bst = lgb.train(params, ds, num_boost_round=10)
+    pred = bst.predict(X)
+    acc = float(np.mean((pred > 0.5) == y))
+    assert acc > 0.8
+
+
+def test_rf_mode():
+    X, y = make_synthetic_binary(n=600, n_features=8)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = {
+        "objective": "binary", "boosting": "rf", "num_leaves": 31,
+        "bagging_freq": 1, "bagging_fraction": 0.7, "verbosity": -1,
+    }
+    bst = lgb.train(params, ds, num_boost_round=20)
+    pred = bst.predict(X)
+    # averaged probabilities, not boosted: still a decent classifier
+    acc = float(np.mean((pred > 0.5) == y))
+    assert acc > 0.85
+    # averaging keeps prediction in a sane probability range
+    assert 0.0 < pred.min() and pred.max() < 1.0
+
+
+def test_rf_score_is_average():
+    X, y = make_synthetic_regression(n=400, n_features=6)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = {
+        "objective": "regression", "boosting": "rf", "num_leaves": 15,
+        "bagging_freq": 1, "bagging_fraction": 0.6, "verbosity": -1,
+    }
+    bst = lgb.train(params, ds, num_boost_round=8)
+    internal = bst._gbdt.get_score(bst._gbdt.train)[0]
+    from_trees = bst.predict(X)  # average_output divides by #trees
+    np.testing.assert_allclose(internal, from_trees, rtol=2e-4, atol=2e-5)
+
+
+def test_rf_save_load_round_trip(tmp_path):
+    X, y = make_synthetic_regression(n=300, n_features=6)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = {
+        "objective": "regression", "boosting": "rf", "num_leaves": 7,
+        "bagging_freq": 1, "bagging_fraction": 0.6, "verbosity": -1,
+    }
+    bst = lgb.train(params, ds, num_boost_round=5)
+    path = tmp_path / "rf.txt"
+    bst.save_model(path)
+    b2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(b2.predict(X), bst.predict(X), rtol=1e-6)
+
+
+def test_rf_requires_bagging():
+    X, y = make_synthetic_regression(n=200, n_features=4)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    with pytest.raises(Exception):
+        lgb.train({"objective": "regression", "boosting": "rf", "verbosity": -1}, ds, 3)
+
+
+def test_boosting_goss_alias_still_works():
+    X, y = make_synthetic_regression(n=300, n_features=6)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "boosting": "goss", "num_leaves": 7,
+         "learning_rate": 0.2, "verbosity": -1},
+        ds, num_boost_round=10,
+    )
+    assert bst.num_trees() == 10
+
+
+def test_dart_custom_objective_sees_dropout():
+    """DART + fobj: gradients must be computed after dropout is applied."""
+    X, y = make_synthetic_regression(n=300, n_features=6)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    seen_preds = []
+
+    def l2_obj(preds, dataset):
+        seen_preds.append(np.asarray(preds).copy())
+        lbl = dataset.get_label()
+        return preds - lbl, np.ones_like(lbl)
+
+    params = {
+        "objective": "none", "boosting": "dart", "num_leaves": 7,
+        "drop_rate": 1.0, "skip_drop": 0.0, "verbosity": -1,
+    }
+    bst = lgb.train(params, ds, num_boost_round=5, fobj=l2_obj)
+    # with drop_rate=1/skip_drop=0 every past iteration drops each round:
+    # the preds handed to fobj must stay near zero (ensemble fully dropped)
+    assert np.abs(seen_preds[-1]).max() < np.abs(y).max()
+    internal = bst._gbdt.get_score(bst._gbdt.train)[0]
+    raw = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(internal, raw, rtol=2e-4, atol=2e-5)
