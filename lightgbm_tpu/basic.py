@@ -404,7 +404,7 @@ class Booster:
         if pred_leaf:
             return self._gbdt.predict_leaf_index(arr, start_iteration, num_iteration)
         if pred_contrib:
-            raise NotImplementedError("pred_contrib (SHAP) is a later milestone")
+            return self._gbdt.predict_contrib(arr, start_iteration, num_iteration)
         return self._gbdt.predict(arr, start_iteration, num_iteration, raw_score=raw_score)
 
     # ------------------------------------------------------------------
@@ -427,6 +427,40 @@ class Booster:
             self.model_to_string(num_iteration, start_iteration, importance_type)
         )
         return self
+
+    def dump_model(
+        self, num_iteration: Optional[int] = None, start_iteration: int = 0,
+        importance_type: str = "split", object_hook=None,
+    ) -> Dict[str, Any]:
+        """JSON model representation (LGBM_BoosterDumpModel)."""
+        from .model_io import dump_model_dict
+
+        ni = num_iteration
+        if ni is None:
+            ni = self.best_iteration if self.best_iteration > 0 else -1
+        return dump_model_dict(
+            self._gbdt, self.config, ni, start_iteration, importance_type
+        )
+
+    def refit(
+        self, data: Any, label: Any, decay_rate: float = 0.9, **kwargs: Any
+    ) -> "Booster":
+        """Refit existing tree structures on new data
+        (Booster.refit / LGBM_BoosterRefit)."""
+        import copy
+
+        arr, _ = _to_2d_numpy(data)
+        new_booster = copy.copy(self)
+        new_booster._gbdt = copy.deepcopy(self._gbdt)
+        new_params = dict(self.config.explicit_params())
+        new_params["refit_decay_rate"] = decay_rate
+        new_booster.config = Config(new_params)
+        new_booster._gbdt.config = new_booster.config
+        new_booster._gbdt.refit(
+            arr, _to_1d(label), weight=kwargs.get("weight"),
+            group=kwargs.get("group"),
+        )
+        return new_booster
 
     def feature_importance(self, importance_type: str = "split", iteration=None) -> np.ndarray:
         return self._gbdt.feature_importance(importance_type)

@@ -405,6 +405,99 @@ class GBDT:
                 cols.append(self.models[it * K + k].predict_leaf(X))
         return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0), np.int64)
 
+    def predict_contrib(self, X, start_iteration=0, num_iteration=-1):
+        """SHAP feature contributions (tree.h:140 PredictContrib)."""
+        from .shap import predict_contrib
+
+        X = np.asarray(X, dtype=np.float64)
+        nf = self.train_set.num_total_features if self.train_set else len(
+            getattr(self, "feature_names", []) or []
+        )
+        if nf == 0:
+            nf = max((int(np.max(t.split_feature)) for t in self.models
+                      if len(t.split_feature)), default=-1) + 1
+            nf = max(nf, X.shape[1])
+        return predict_contrib(
+            self.models, X, nf, self.num_class, start_iteration,
+            num_iteration, self.average_output,
+        )
+
+    def refit(self, X: np.ndarray, label: np.ndarray, weight=None, group=None) -> None:
+        """Refit leaf values of the existing tree structures on new data
+        (gbdt.cpp:266 RefitTree + tree_learner FitByExistingTree): walk
+        each model tree over the new rows, recompute leaf outputs from
+        the objective's gradients at the progressively-updated score, and
+        blend with refit_decay_rate."""
+        import jax.numpy as jnp
+
+        X = np.asarray(X, dtype=np.float64)
+        label = np.asarray(label, dtype=np.float32)
+        N = X.shape[0]
+        K = self.num_class
+        c = self.config
+        decay = c.refit_decay_rate
+        lam = c.lambda_l2
+
+        # leaf assignment of every (row, model tree) on the new data
+        leaf_pred = self.predict_leaf_index(X)  # (N, num_models)
+
+        # a minimal dataset shim so a fresh objective can init on the new
+        # data (no padding needed: gradients run in plain numpy here)
+        from .dataset import Metadata
+        from .objectives import create_objective
+
+        md = Metadata(
+            label=label,
+            weight=None if weight is None else np.asarray(weight, np.float32),
+            group=None if group is None else np.asarray(group, np.int32),
+        )
+
+        class _Shim:
+            metadata = md
+            num_data = N
+
+            @staticmethod
+            def padded(arr, fill: float = 0.0, dtype=np.float32):
+                return np.asarray(arr, dtype)
+
+        obj = create_objective(c)
+        if obj is None:
+            log.fatal("Cannot refit without an objective function")
+        obj.init(_Shim())
+
+        score = np.zeros((K, N), np.float64)
+        for it in range(len(self.models) // K):
+            gs, hs = obj.get_gradients(jnp.asarray(
+                score if K > 1 else score[0], jnp.float32))
+            gs = np.asarray(gs, np.float64).reshape(K, N)
+            hs = np.asarray(hs, np.float64).reshape(K, N)
+            for k in range(K):
+                mi = it * K + k
+                t = self.models[mi]
+                g, h = gs[k], hs[k]
+                leaves = leaf_pred[:, mi]
+                sum_g = np.bincount(leaves, weights=g, minlength=t.num_leaves)
+                sum_h = np.bincount(leaves, weights=h, minlength=t.num_leaves)
+                shrink = t.shrinkage
+                new_out = np.where(
+                    sum_h + lam > 1e-15, -sum_g / (sum_h + lam), 0.0
+                ) * shrink
+                # cover stats (leaf_count/internal_count) stay as trained,
+                # like the reference's FitByExistingTree
+                t.leaf_value = decay * t.leaf_value + (1.0 - decay) * new_out
+                score[k] += t.leaf_value[leaves]
+        # keep device copies consistent (device leaf_value mirrors the
+        # final host leaf_value, see train_one_iter)
+        for mi, (arrays, aux) in enumerate(self.device_trees):
+            if mi < len(self.models):
+                lv = arrays.leaf_value
+                host = np.zeros(lv.shape, np.float32)
+                n = min(len(host), len(self.models[mi].leaf_value))
+                host[:n] = self.models[mi].leaf_value[:n]
+                self.device_trees[mi] = (
+                    arrays._replace(leaf_value=jnp.asarray(host)), aux
+                )
+
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         nf = self.train_set.num_total_features if self.train_set else (
             max((int(np.max(t.split_feature)) for t in self.models if len(t.split_feature)), default=-1) + 1

@@ -141,6 +141,130 @@ def save_model_string(
 
 
 # ----------------------------------------------------------------------
+def _node_to_dict(t: Tree, index: int) -> Dict[str, Any]:
+    """Nested node dict (src/io/tree.cpp:462 NodeToJSON)."""
+    if index >= 0:
+        dt = int(t.decision_type[index])
+        d: Dict[str, Any] = {
+            "split_index": index,
+            "split_feature": int(t.split_feature[index]),
+            "split_gain": float(t.split_gain[index]),
+        }
+        if dt & 1:  # categorical
+            ci = int(t.threshold[index])
+            lo, hi = int(t.cat_boundaries[ci]), int(t.cat_boundaries[ci + 1])
+            words = t.cat_threshold[lo:hi]
+            cats = [
+                32 * w + b
+                for w in range(len(words))
+                for b in range(32)
+                if (int(words[w]) >> b) & 1
+            ]
+            d["threshold"] = "||".join(str(cv) for cv in cats)
+            d["decision_type"] = "=="
+        else:
+            d["threshold"] = float(t.threshold[index])
+            d["decision_type"] = "<="
+        d["default_left"] = bool(dt & 2)
+        d["missing_type"] = ("None", "Zero", "NaN")[min((dt >> 2) & 3, 2)]
+        d["internal_value"] = float(t.internal_value[index]) if index < len(t.internal_value) else 0.0
+        d["internal_weight"] = float(t.internal_weight[index]) if index < len(t.internal_weight) else 0.0
+        d["internal_count"] = int(t.internal_count[index]) if index < len(t.internal_count) else 0
+        d["left_child"] = _node_to_dict(t, int(t.left_child[index]))
+        d["right_child"] = _node_to_dict(t, int(t.right_child[index]))
+        return d
+    leaf = ~index
+    return {
+        "leaf_index": leaf,
+        "leaf_value": float(t.leaf_value[leaf]),
+        "leaf_weight": float(t.leaf_weight[leaf]) if leaf < len(t.leaf_weight) else 0.0,
+        "leaf_count": int(t.leaf_count[leaf]) if leaf < len(t.leaf_count) else 0,
+    }
+
+
+def tree_to_dict(t: Tree, tree_index: int) -> Dict[str, Any]:
+    """(src/io/tree.cpp:415 ToJSON)"""
+    d: Dict[str, Any] = {
+        "tree_index": tree_index,
+        "num_leaves": t.num_leaves,
+        "num_cat": t.num_cat,
+        "shrinkage": t.shrinkage,
+    }
+    if t.num_leaves == 1:
+        d["tree_structure"] = {
+            "leaf_value": float(t.leaf_value[0]),
+            "leaf_count": int(t.leaf_count[0]) if len(t.leaf_count) else 0,
+        }
+    else:
+        d["tree_structure"] = _node_to_dict(t, 0)
+    return d
+
+
+def dump_model_dict(
+    gbdt: GBDT, cfg: Config, num_iteration: int = -1, start_iteration: int = 0,
+    importance_type: str = "split",
+) -> Dict[str, Any]:
+    """JSON model dump (gbdt_model_text.cpp:24 DumpModel), as returned by
+    Booster.dump_model()."""
+    ds = gbdt.train_set
+    feature_names = ds.feature_names if ds is not None else getattr(gbdt, "feature_names", [])
+    feature_infos = ds.feature_infos() if ds is not None else getattr(
+        gbdt, "feature_infos_", ["none"] * len(feature_names))
+    K = gbdt.num_class
+
+    total_iteration = len(gbdt.models) // K
+    start_iteration = max(0, min(start_iteration, total_iteration))
+    num_used = len(gbdt.models)
+    if num_iteration > 0:
+        num_used = min((start_iteration + num_iteration) * K, num_used)
+    start_model = start_iteration * K
+
+    infos = []
+    for s in feature_infos:
+        if s.startswith("["):
+            lo, hi = s[1:-1].split(":")
+            infos.append({"min_value": float(lo), "max_value": float(hi), "values": []})
+        elif s and s != "none":
+            infos.append({
+                "min_value": 0, "max_value": 0,
+                "values": [int(v) for v in s.split(":")],
+            })
+        else:
+            infos.append({"min_value": 0, "max_value": 0, "values": []})
+
+    # importances over exactly the dumped tree range
+    imp = np.zeros(len(feature_names))
+    for i in range(start_model, num_used):
+        t = gbdt.models[i]
+        if importance_type == "gain":
+            imp += t.feature_importance_gain(len(feature_names))
+        else:
+            imp += t.feature_importance_split(len(feature_names))
+    cast = float if importance_type == "gain" else int
+    pairs = [(cast(imp[i]), feature_names[i]) for i in range(len(feature_names)) if imp[i] > 0]
+    pairs.sort(key=lambda p: -p[0])
+
+    return {
+        "name": "tree",
+        "version": MODEL_VERSION,
+        "num_class": cfg.num_class,
+        "num_tree_per_iteration": K,
+        "label_index": 0,
+        "max_feature_idx": len(feature_names) - 1,
+        "objective": _objective_to_string(cfg),
+        "average_output": bool(gbdt.average_output),
+        "feature_names": list(feature_names),
+        "monotone_constraints": list(cfg.monotone_constraints),
+        "feature_infos": dict(zip(feature_names, infos)),
+        "tree_info": [
+            tree_to_dict(gbdt.models[i], i - start_model)
+            for i in range(start_model, num_used)
+        ],
+        "feature_importances": {name: v for v, name in pairs},
+        "pandas_categorical": None,
+    }
+
+
 def _parse_array(s: str, typ) -> np.ndarray:
     s = s.strip()
     if not s:

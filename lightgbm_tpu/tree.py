@@ -153,6 +153,29 @@ class Tree:
         bits = (words[ivc // 32] >> (ivc % 32).astype(np.uint32)) & 1
         return ok & (bits == 1)
 
+    def go_left(self, node: int, x: np.ndarray) -> bool:
+        """Scalar decision for one row at one node — the single source of
+        truth for decision semantics shared with the vectorized walk below
+        (tree.h Decision/CategoricalDecision)."""
+        v = x[self.split_feature[node]]
+        dt = int(self.decision_type[node])
+        if dt & _CAT_MASK:
+            if np.isnan(v):
+                return False
+            return bool(self._cat_in_bitset(node, np.asarray([v]))[0])
+        missing_type = (dt >> 2) & 3
+        default_left = bool(dt & _DEFAULT_LEFT_MASK)
+        isna = np.isnan(v)
+        if missing_type == 2:  # NaN as missing
+            if isna:
+                return default_left
+        else:
+            if isna:
+                v = 0.0
+            if missing_type == 1 and abs(v) <= 1e-35:  # Zero as missing
+                return default_left
+        return bool(v <= self.threshold[node])
+
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """Vectorized decision walk -> leaf index per row (Tree::Predict)."""
         n = X.shape[0]
