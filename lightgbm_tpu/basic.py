@@ -438,9 +438,16 @@ class Booster:
         ni = num_iteration
         if ni is None:
             ni = self.best_iteration if self.best_iteration > 0 else -1
-        return dump_model_dict(
+        d = dump_model_dict(
             self._gbdt, self.config, ni, start_iteration, importance_type
         )
+        if object_hook is not None:
+            # apply like json.loads(..., object_hook=...): bottom-up over
+            # every dict in the structure
+            import json
+
+            d = json.loads(json.dumps(d), object_hook=object_hook)
+        return d
 
     def refit(
         self, data: Any, label: Any, decay_rate: float = 0.9, **kwargs: Any
@@ -451,7 +458,12 @@ class Booster:
 
         arr, _ = _to_2d_numpy(data)
         new_booster = copy.copy(self)
-        new_booster._gbdt = copy.deepcopy(self._gbdt)
+        # shallow-copy the GBDT: refit only rewrites host tree leaf values
+        # and replaces device_trees entries, so sharing the (possibly
+        # device-resident) dataset buffers avoids doubling memory
+        new_booster._gbdt = copy.copy(self._gbdt)
+        new_booster._gbdt.models = [copy.deepcopy(t) for t in self._gbdt.models]
+        new_booster._gbdt.device_trees = list(self._gbdt.device_trees)
         new_params = dict(self.config.explicit_params())
         new_params["refit_decay_rate"] = decay_rate
         new_booster.config = Config(new_params)

@@ -479,9 +479,16 @@ class GBDT:
                 sum_g = np.bincount(leaves, weights=g, minlength=t.num_leaves)
                 sum_h = np.bincount(leaves, weights=h, minlength=t.num_leaves)
                 shrink = t.shrinkage
+                # full CalculateSplittedLeafOutput: L1 soft-threshold +
+                # max_delta_step clip (feature_histogram.hpp, mirrored by
+                # learner/split.py leaf_output)
+                tg = np.sign(sum_g) * np.maximum(np.abs(sum_g) - c.lambda_l1, 0.0)
                 new_out = np.where(
-                    sum_h + lam > 1e-15, -sum_g / (sum_h + lam), 0.0
-                ) * shrink
+                    sum_h + lam > 1e-15, -tg / (sum_h + lam), 0.0
+                )
+                if c.max_delta_step > 0.0:
+                    new_out = np.clip(new_out, -c.max_delta_step, c.max_delta_step)
+                new_out = new_out * shrink
                 # cover stats (leaf_count/internal_count) stay as trained,
                 # like the reference's FitByExistingTree
                 t.leaf_value = decay * t.leaf_value + (1.0 - decay) * new_out

@@ -29,7 +29,9 @@ from . import log
 from .binning import BinMapper, BinType, MissingType
 from .config import Config
 
-DEFAULT_ROW_BLOCK = 1024
+from .learner.histogram import HIST_BLK
+
+DEFAULT_ROW_BLOCK = HIST_BLK  # pallas histogram row block
 
 
 def _choose_bin_dtype(max_num_bin: int) -> Any:
@@ -181,6 +183,15 @@ class BinnedDataset:
         meta.check(num_data)
 
         row_block = config.tpu_row_block or DEFAULT_ROW_BLOCK
+        if row_block % HIST_BLK != 0:
+            # non-HIST_BLK-multiple padding would silently route every
+            # histogram to the einsum fallback on TPU; round up instead
+            rounded = ((row_block + HIST_BLK - 1) // HIST_BLK) * HIST_BLK
+            log.warning(
+                f"tpu_row_block={row_block} is not a multiple of the pallas "
+                f"histogram block ({HIST_BLK}); rounding up to {rounded}"
+            )
+            row_block = rounded
         return BinnedDataset(
             bins=bins,
             mappers=mappers,
@@ -267,10 +278,10 @@ class BinnedDataset:
         """Push the bin matrix + per-feature info to device (cached).
 
         Returns dict with:
-          bins      (nblocks, F, Bk) int32 — bin matrix in row blocks of
-                    size row_block (feature-major inside a block), rows
-                    padded with 0; this is the layout `leaf_histogram`
-                    scans so no transpose happens inside the train loop
+          bins      (Np, F) int32 — row-major bin matrix, rows padded
+                    with bin 0 to a row_block multiple; rows ride the
+                    sublane axis so the pallas histogram kernel's
+                    one-hot compare needs no relayout
           valid     (Np,)  float32  — 1.0 for real rows, 0.0 for padding
           nan_bin   (F,)   int32    — NaN bin index per feature, -1 if none
           num_bins  (F,)   int32    — per-feature bin count
@@ -283,12 +294,8 @@ class BinnedDataset:
 
         npad = self.num_rows_padded()
         f = self.num_used_features
-        bins_p = np.zeros((f, npad), dtype=np.int32)
-        bins_p[:, : self.num_data] = self.bins
-        nblocks = npad // self.row_block
-        bins_blocked = np.ascontiguousarray(
-            bins_p.reshape(f, nblocks, self.row_block).transpose(1, 0, 2)
-        )
+        bins_rm = np.zeros((npad, f), dtype=np.int32)
+        bins_rm[: self.num_data, :] = self.bins.T
         um = self.used_mappers()
         nan_bin = np.array([m.nan_bin for m in um], dtype=np.int32)
         num_bins = np.array([m.num_bin for m in um], dtype=np.int32)
@@ -301,7 +308,7 @@ class BinnedDataset:
         valid = np.zeros(npad, dtype=np.float32)
         valid[: self.num_data] = 1.0
         self._device = {
-            "bins": jnp.asarray(bins_blocked),
+            "bins": jnp.asarray(bins_rm),
             "valid": jnp.asarray(valid),
             "nan_bin": jnp.asarray(nan_bin),
             "num_bins": jnp.asarray(num_bins),

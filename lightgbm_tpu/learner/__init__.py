@@ -8,12 +8,14 @@ The package-level split mirrors the reference learner decomposition
 """
 
 from .grower import GrowerSpec, TreeArrays, grow_tree, make_split_params
-from .histogram import leaf_histogram
+from .histogram import HIST_BLK, build_gh8, histogram
 
 __all__ = [
     "GrowerSpec",
     "TreeArrays",
     "grow_tree",
     "make_split_params",
-    "leaf_histogram",
+    "histogram",
+    "build_gh8",
+    "HIST_BLK",
 ]

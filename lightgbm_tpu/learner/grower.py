@@ -34,11 +34,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import (
+    build_gh8,
+    gather_gh8,
     gather_rows,
     hist_capacities,
-    leaf_histogram,
-    leaf_histogram_rows,
-    masked_leaf_histogram,
+    histogram,
     root_sums,
 )
 from .split import NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
@@ -141,7 +141,7 @@ def _get_best(best: SplitRecord, l: jax.Array) -> SplitRecord:
 
 @partial(jax.jit, static_argnames=("spec",))
 def grow_tree(
-    bins_blocked: jax.Array,  # (nblocks, F, Bk) int32
+    bins_rm: jax.Array,  # (N, F) int32 — row-major bin matrix
     nan_bin: jax.Array,  # (F,)
     num_bins: jax.Array,  # (F,)
     mono: jax.Array,  # (F,)
@@ -163,15 +163,14 @@ def grow_tree(
     """
     L = spec.num_leaves
     B = spec.num_bins
-    nb, F, Bk = bins_blocked.shape
-    N = nb * Bk
+    N, F = bins_rm.shape
     ax = spec.axis_name
     caps = hist_capacities(N)
 
-    gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1)  # (N, 3)
-    root = root_sums(gh, ax)
+    gh8 = build_gh8(grad * mask, hess * mask, mask)  # (8, N)
+    root = root_sums(gh8, ax)
 
-    hist0 = leaf_histogram(bins_blocked, gh, B)
+    hist0 = histogram(bins_rm, gh8, B)
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
     rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin, mono, is_cat, params, feat_mask)
@@ -261,7 +260,7 @@ def grow_tree(
 
         # ---- partition: update per-row leaf ids (cuda_data_partition.cu) ----
         f = rec.feature
-        fbins = lax.dynamic_slice_in_dim(bins_blocked, f, 1, axis=1).reshape(N)
+        fbins = lax.dynamic_slice_in_dim(bins_rm, f, 1, axis=1).reshape(N)
         fnan = nan_bin[f]
         go_left = jnp.where(
             rec.is_cat,
@@ -294,9 +293,9 @@ def grow_tree(
             def mk_branch(cap: int):
                 def branch(_):
                     idx = jnp.nonzero(on_small, size=cap, fill_value=N)[0]
-                    bb = gather_rows(bins_blocked, idx)  # (cap, F)
-                    gg = jnp.take(gh, idx, axis=0, mode="fill", fill_value=0.0)
-                    return leaf_histogram_rows(bb, gg, B)
+                    bb = gather_rows(bins_rm, idx)  # (cap, F)
+                    gg = gather_gh8(gh8, idx)  # (8, cap)
+                    return histogram(bb, gg, B)
 
                 return branch
 
@@ -313,7 +312,8 @@ def grow_tree(
                 bidx = jnp.where(cnt_small > caps[0], len(caps), bidx)
             small_hist = lax.switch(bidx, branches, None)
         else:
-            small_hist = masked_leaf_histogram(bins_blocked, gh, row_leaf, small_id, B)
+            on_small_f = (row_leaf == small_id).astype(gh8.dtype)
+            small_hist = histogram(bins_rm, gh8 * on_small_f[None, :], B)
         if ax is not None:
             small_hist = lax.psum(small_hist, ax)
         large_hist = parent_hist - small_hist

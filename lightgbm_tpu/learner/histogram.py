@@ -1,104 +1,125 @@
-"""Feature-histogram construction as MXU matmuls.
+"""Feature-histogram construction.
 
 The reference builds per-(leaf, feature) histograms of (sum_grad,
 sum_hess, count) with sequential scatter loops on CPU
 (src/io/dense_bin.hpp:99-174 ConstructHistogram) and shared-memory
 atomics on CUDA (src/treelearner/cuda/cuda_histogram_constructor.cu).
-Scatter-add is the wrong primitive for a TPU; instead each block of rows
-is expanded to a one-hot {0,1} matrix over the bin axis and contracted
-against the (grad, hess, count) channels — a batched matmul that tiles
-onto the MXU. A `lax.scan` over row blocks bounds the one-hot
-materialization to one block at a time.
+A TPU has no vector scatter, so scatter-add becomes a one-hot
+contraction. Two backends share one data layout:
 
-Accumulation is float32 (`preferred_element_type`), matching the CUDA
-backend's float histograms (gpu_hist_t) rather than the CPU's doubles.
+- **Pallas TPU kernel** (`pallas_hist.hist_tpu`): the one-hot tile only
+  ever lives in VMEM, the contraction rides the MXU. Requires the row
+  count to be a multiple of `HIST_BLK`.
+- **XLA einsum fallback** (CPU tests, virtual meshes, odd row counts):
+  same math, one-hot materialized per small row block under `lax.scan`.
+
+Layout: bins are row-major `(N, F)` int32 (rows on sublanes — the
+pallas kernel's one-hot compare then needs no lane->sublane relayout);
+per-row channels are `(8, N)` f32 rows `(g_hi, g_lo, h_hi, h_lo, count,
+0, 0, 0)`. The bf16x2 split (hi = bf16(x), lo = x - hi) lets the MXU run
+in bf16 while the recombined histogram keeps ~f32 accuracy — the padded
+channel slots are free because the matmul M dim pads 3 -> 8 anyway.
+Gradient/hessian are summed per bin exactly like the reference's f64
+histograms (hist_t), at float precision like its GPU path (gpu_hist_t,
+docs/GPU-Performance.rst accuracy table).
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+HIST_BLK = 2048  # pallas row-block; device row padding is a multiple of this
+CH = 8
 
-def _hist_scan(
-    bins_fb: jax.Array,  # (nblocks, F, Bk) int — feature-major row blocks
-    gh_b: jax.Array,  # (nblocks, Bk, 3) f32
-    num_bins: int,
-) -> jax.Array:
-    """Shared one-hot-matmul accumulation body: (F, B, 3) f32."""
-    nblocks, F, Bk = bins_fb.shape
-    iota = jnp.arange(num_bins, dtype=bins_fb.dtype)
+
+def _use_pallas() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def build_gh8(grad: jax.Array, hess: jax.Array, count: jax.Array) -> jax.Array:
+    """(N,) grad/hess/count (already masked) -> (8, N) bf16x2-split channels."""
+    g_hi = grad.astype(jnp.bfloat16).astype(jnp.float32)
+    g_lo = grad - g_hi
+    h_hi = hess.astype(jnp.bfloat16).astype(jnp.float32)
+    h_lo = hess - h_hi
+    z = jnp.zeros_like(count)
+    return jnp.stack([g_hi, g_lo, h_hi, h_lo, count, z, z, z])
+
+
+def combine_ch(hist8: jax.Array) -> jax.Array:
+    """(F, CH, B) accumulated channels -> (F, B, 3) (grad, hess, count)."""
+    g = hist8[:, 0, :] + hist8[:, 1, :]
+    h = hist8[:, 2, :] + hist8[:, 3, :]
+    c = hist8[:, 4, :]
+    return jnp.stack([g, h, c], axis=-1)
+
+
+def _hist_fallback(bins_rm: jax.Array, gh8: jax.Array, num_bins: int,
+                   blk: int = 512) -> jax.Array:
+    """One-hot einsum under lax.scan; any N (pads to a block multiple)."""
+    N, F = bins_rm.shape
+    gh3 = jnp.stack(
+        [gh8[0] + gh8[1], gh8[2] + gh8[3], gh8[4]], axis=-1
+    )  # (N, 3)
+    if N % blk != 0:
+        pad = blk - N % blk
+        bins_rm = jnp.pad(bins_rm, ((0, pad), (0, 0)))
+        gh3 = jnp.pad(gh3, ((0, pad), (0, 0)))
+        N += pad
+    nb = N // blk
+    bb = bins_rm.reshape(nb, blk, F)
+    gg = gh3.reshape(nb, blk, 3)
+    iota = jnp.arange(num_bins, dtype=bins_rm.dtype)
 
     def body(acc, xs):
-        b, g = xs  # (F, Bk) int, (Bk, 3) f32
-        onehot = (b[:, :, None] == iota).astype(jnp.float32)  # (F, Bk, B)
+        b, g = xs  # (blk, F), (blk, 3)
+        onehot = (b[:, :, None] == iota).astype(jnp.float32)  # (blk, F, B)
         acc = acc + jnp.einsum(
-            "frb,rc->fbc", onehot, g, preferred_element_type=jnp.float32
+            "rfb,rc->fbc", onehot, g, preferred_element_type=jnp.float32
         )
         return acc, None
 
     init = jnp.zeros((F, num_bins, 3), dtype=jnp.float32)
-    hist, _ = lax.scan(body, init, (bins_fb, gh_b))
+    hist, _ = lax.scan(body, init, (bb, gg))
     return hist
 
 
-def leaf_histogram(
-    bins_blocked: jax.Array,  # (nblocks, F, Bk) int32 — feature-major row blocks
-    gh: jax.Array,  # (N, 3) float32 — (grad, hess, count) already masked to the leaf
-    num_bins: int,  # uniform bin-axis size B
-) -> jax.Array:
-    """Return (F, B, 3) histogram of the rows whose gh mask is nonzero."""
-    nblocks, F, Bk = bins_blocked.shape
-    return _hist_scan(bins_blocked, gh.reshape(nblocks, Bk, 3), num_bins)
+def histogram(bins_rm: jax.Array, gh8: jax.Array, num_bins: int) -> jax.Array:
+    """(N, F) int32 bins + (8, N) channels -> (F, B, 3) f32 histogram."""
+    N, F = bins_rm.shape
+    if _use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK:
+        from .pallas_hist import hist_tpu
+
+        return combine_ch(hist_tpu(bins_rm, gh8, num_bins))
+    return _hist_fallback(bins_rm, gh8, num_bins)
 
 
-def leaf_histogram_rows(
-    bins_rows: jax.Array,  # (R, F) int32 — gathered rows, row-major
-    gh_rows: jax.Array,  # (R, 3) f32
-    num_bins: int,
-    block: int = 512,
-) -> jax.Array:
-    """Histogram over a gathered row subset (row-major layout).
-
-    Same one-hot-matmul formulation as `leaf_histogram`, but over a
-    compacted buffer whose size is a power-of-two fraction of N — the
-    TPU analog of the reference constructing histograms only over the
-    leaf's index list (data_partition.hpp + dense_bin.hpp:99 loops over
-    data_indices)."""
-    R, F = bins_rows.shape
-    if R % block != 0:
-        # pad to a block multiple (zero gh -> no contribution); keeps the
-        # scan tiled even for odd-sized fallback buffers
-        pad = block - R % block
-        bins_rows = jnp.pad(bins_rows, ((0, pad), (0, 0)))
-        gh_rows = jnp.pad(gh_rows, ((0, pad), (0, 0)))
-        R += pad
-    nb = R // block
-    bb = bins_rows.reshape(nb, block, F).transpose(0, 2, 1)  # (nb, F, block)
-    gg = gh_rows.reshape(nb, block, 3)
-    return _hist_scan(bb, gg, num_bins)
+def gather_rows(bins_rm: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather rows by index -> (len(idx), F). Out-of-range idx (pad
+    slots) fill with bin 0; callers zero their gh so those rows
+    contribute nothing."""
+    return jnp.take(bins_rm, idx, axis=0, mode="fill", fill_value=0)
 
 
-def gather_rows(bins_blocked: jax.Array, idx: jax.Array) -> jax.Array:
-    """Gather rows by flat index from the blocked (nblocks, F, Bk) layout
-    -> (len(idx), F). Out-of-range idx (pad slots) clamp; callers zero
-    their gh so clamped rows contribute nothing."""
-    nb, F, Bk = bins_blocked.shape
-    blk = jnp.clip(idx // Bk, 0, nb - 1)
-    off = idx % Bk
-    return bins_blocked[blk, :, off]
+def gather_gh8(gh8: jax.Array, idx: jax.Array) -> jax.Array:
+    return jnp.take(gh8, idx, axis=1, mode="fill", fill_value=0.0)
 
 
-def hist_capacities(n_rows: int, min_cap: int = 1024) -> tuple:
-    """Static ladder of gather-buffer sizes: N/2, N/4, ... >= min_cap.
-    The smaller child always fits in N/2; deep (small) leaves use the
-    small buffers so histogram cost tracks leaf size."""
+def hist_capacities(n_rows: int, min_cap: int = HIST_BLK) -> tuple:
+    """Static ladder of gather-buffer sizes: N/2, N/4, ... >= min_cap,
+    each rounded up to a HIST_BLK multiple. The smaller child always
+    fits in N/2; deep (small) leaves use the small buffers so histogram
+    cost tracks leaf size."""
+
     def _round(c: int) -> int:
-        return ((c + 511) // 512) * 512
+        return ((c + HIST_BLK - 1) // HIST_BLK) * HIST_BLK
 
     caps = []
     c = n_rows // 2
@@ -110,24 +131,12 @@ def hist_capacities(n_rows: int, min_cap: int = 1024) -> tuple:
     return tuple(caps)
 
 
-def masked_leaf_histogram(
-    bins_blocked: jax.Array,
-    gh_all: jax.Array,  # (N, 3) masked for validity/bagging but not leaf
-    row_leaf: jax.Array,  # (N,) int32
-    leaf: jax.Array,  # scalar int32
-    num_bins: int,
-) -> jax.Array:
-    """Histogram of rows currently assigned to `leaf`."""
-    mask = (row_leaf == leaf).astype(gh_all.dtype)
-    return leaf_histogram(bins_blocked, gh_all * mask[:, None], num_bins)
-
-
-def root_sums(gh: jax.Array, axis_name: Optional[str] = None) -> jax.Array:
-    """(sum_grad, sum_hess, count) over all in-bag rows; float64-free but
-    accumulated in f32 pairwise by jnp.sum. Globally reduced over the data
-    mesh axis when present (reference data_parallel_tree_learner.cpp:169-221
-    root allreduce)."""
-    s = jnp.sum(gh, axis=0)
+def root_sums(gh8: jax.Array, axis_name: Optional[str] = None) -> jax.Array:
+    """(sum_grad, sum_hess, count) over all in-bag rows. Globally reduced
+    over the data mesh axis when present (reference
+    data_parallel_tree_learner.cpp:169-221 root allreduce)."""
+    s8 = jnp.sum(gh8, axis=1)
+    s = jnp.stack([s8[0] + s8[1], s8[2] + s8[3], s8[4]])
     if axis_name is not None:
         s = lax.psum(s, axis_name)
     return s

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from lightgbm_tpu.learner.grower import GrowerSpec, grow_tree
-from lightgbm_tpu.learner.histogram import leaf_histogram
+from lightgbm_tpu.learner.histogram import build_gh8, histogram
 from lightgbm_tpu.learner.split import SplitParams, best_split
 
 
@@ -55,11 +55,11 @@ def test_best_split_matches_oracle():
     B = 16
     bins, grad, hess = _mk_problem(B=B)
     F, n = bins.shape
-    gh = jnp.stack(
-        [jnp.asarray(grad), jnp.asarray(hess), jnp.ones(n, jnp.float32)], axis=-1
+    gh8 = build_gh8(
+        jnp.asarray(grad), jnp.asarray(hess), jnp.ones(n, jnp.float32)
     )
-    bins_blocked = jnp.asarray(bins.reshape(F, 2, n // 2).transpose(1, 0, 2))
-    hist = leaf_histogram(bins_blocked, gh, B)
+    bins_rm = jnp.asarray(bins.T.copy())
+    hist = histogram(bins_rm, gh8, B)
     # each feature's histogram partitions all rows -> per-feature totals
     np.testing.assert_allclose(
         np.asarray(hist[:, :, 0]).sum(axis=1), np.full(F, grad.sum()), rtol=1e-4
@@ -79,14 +79,11 @@ def test_best_split_matches_oracle():
     assert float(rec.gain) == pytest.approx(oracle, rel=1e-4)
 
 
-def _grow(bins, grad, hess, spec, row_block=256):
+def _grow(bins, grad, hess, spec):
     F, n = bins.shape
-    nb = n // row_block
-    bins_blocked = jnp.asarray(
-        bins.reshape(F, nb, row_block).transpose(1, 0, 2)
-    )
+    bins_rm = jnp.asarray(bins.T.copy())
     args = (
-        bins_blocked,
+        bins_rm,
         jnp.full(F, -1, jnp.int32),
         jnp.full(F, spec.num_bins, jnp.int32),
         jnp.zeros(F, jnp.int32),
@@ -123,9 +120,7 @@ def test_data_parallel_matches_serial():
         pytest.skip("needs 8 devices")
     bins, grad, hess = _mk_problem(n=4096, F=6, B=32, seed=5)
     F, n = bins.shape
-    row_block = 256
-    nb = n // row_block
-    bins_blocked = jnp.asarray(bins.reshape(F, nb, row_block).transpose(1, 0, 2))
+    bins_rm = jnp.asarray(bins.T.copy())
     spec = GrowerSpec(num_leaves=15, num_bins=32, max_depth=-1)
     params = _params(min_data_in_leaf=5.0)
     common = (
@@ -135,13 +130,13 @@ def test_data_parallel_matches_serial():
         jnp.ones(F, bool), params,
     )
     t_serial, rl_serial = grow_tree(
-        bins_blocked, *common[:-1], common[-1], spec, valid=jnp.ones(n, jnp.float32)
+        bins_rm, *common[:-1], common[-1], spec, valid=jnp.ones(n, jnp.float32)
     )
 
     mesh = make_mesh(jax.devices()[:8])
     dp = DataParallelGrower(mesh, spec)
     t_dp, rl_dp = dp(
-        bins_blocked, *common, jnp.ones(n, jnp.float32)
+        bins_rm, *common, jnp.ones(n, jnp.float32)
     )
     assert int(t_dp.num_nodes) == int(t_serial.num_nodes)
     np.testing.assert_array_equal(
