@@ -60,12 +60,25 @@ class GBDT:
         self.num_class = config.num_model_per_iteration
         self.shrinkage_rate = config.learning_rate
         self.average_output = False  # RF mode divides prediction by #iters
-        self.models: List[Tree] = []  # flat, iteration-major (models_[it*K + k])
+        self._models: List[Tree] = []  # flat, iteration-major (models_[it*K + k])
         self.device_trees: List[Tuple[TreeArrays, Any]] = []  # (arrays w/ final leaf values, None)
         self.iter_ = 0
         self.best_iteration = -1
         self.valids: List[_ScoreSet] = []
         self._traverse = _jit_traverse()
+        # ---- async training pipeline (the TPU analog of the reference's
+        # synchronous per-iteration loop): under the axon runtime any
+        # device->host readback both costs a ~70ms sync AND permanently
+        # degrades dispatch latency, so the fast path materializes host
+        # trees lazily in batches (one device_get) and checks the
+        # "no splittable leaf" stop condition only every _check_every
+        # iterations. DART/RF and leaf-renewal objectives need per-iter
+        # host work and force the synchronous path.
+        self._pending: List[TreeArrays] = []
+        self._pending_meta: List[Tuple[int, float, float]] = []  # (k, bias, shrinkage)
+        self._stopped = False
+        self._check_every = 50
+        self._force_sync = False
 
         if train_set is None:
             return  # prediction-only booster (model loaded from file)
@@ -129,23 +142,124 @@ class GBDT:
         return self.train_set.metadata.init_score is not None
 
     # ------------------------------------------------------------------
+    @property
+    def models(self) -> List[Tree]:
+        self._materialize()
+        return self._models
+
+    @models.setter
+    def models(self, value: List[Tree]) -> None:
+        self._pending = []
+        self._pending_meta = []
+        self._models = value
+
+    def _materialize(self) -> None:
+        """Fetch all pending device trees in ONE device_get and convert to
+        host Trees; detects the reference's stop condition (an iteration
+        where no class-tree could split, gbdt.cpp:429-452) after the fact
+        and drops that iteration and everything behind it."""
+        if not self._pending:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        host = jax.device_get(self._pending)
+        meta = self._pending_meta
+        self._pending = []
+        self._pending_meta = []
+        K = self.num_class
+        base = len(self._models)  # device_trees index of host[0]
+        for i0 in range(0, len(host), K):
+            group = host[i0 : i0 + K]
+            if all(int(a.num_nodes) == 0 for a in group):
+                if base + i0 == 0:
+                    # first-ever iteration has no splits: keep K constant
+                    # trees carrying the bias (sync path / gbdt.cpp:429-441
+                    # keep the len==K model set)
+                    for a, (k, bias, shrink) in zip(group, meta[i0 : i0 + K]):
+                        if (
+                            abs(bias) < 1e-15
+                            and self.objective is not None
+                            and not self.config.boost_from_average
+                            and not self.has_init_score
+                        ):
+                            bias = self.objective.boost_from_score(k)
+                            if abs(bias) > 1e-15:
+                                self.train.score = self.train.score.at[k].add(bias)
+                                for vs in self.valids:
+                                    vs.score = vs.score.at[k].add(bias)
+                        t = Tree(num_leaves=1, shrinkage=1.0)
+                        t.leaf_value = np.array([bias], np.float64)
+                        self._models.append(t)
+                    i0 += K
+                # roll back score contributions of any blindly-trained
+                # later iterations that DID split (possible under bagging)
+                for j in range(i0, len(host)):
+                    if int(host[j].num_nodes) == 0:
+                        continue
+                    arrays, _ = self.device_trees[base + j]
+                    k = meta[j][0]
+                    leaf = self._traverse(
+                        arrays, self.dev["bins"], self.dev["nan_bin"]
+                    )
+                    self.train.score = self.train.score.at[k].add(
+                        -arrays.leaf_value[leaf]
+                    )
+                    for vs in self.valids:
+                        vdev = vs.dataset.device_arrays()
+                        vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                        vs.score = vs.score.at[k].add(-arrays.leaf_value[vleaf])
+                log.warning(
+                    "Stopped training because there are no more leaves that meet the split requirements"
+                )
+                del self.device_trees[len(self._models) :]
+                self.iter_ = len(self._models) // K
+                self._stopped = True
+                return
+            for a, (k, bias, shrink) in zip(group, meta[i0 : i0 + K]):
+                n_nodes = int(a.num_nodes)
+                if n_nodes > 0:
+                    # device leaf_value already carries shrinkage + bias
+                    tree = Tree.from_arrays(a, self.train_set, 1.0)
+                    tree.shrinkage = shrink
+                else:
+                    tree = Tree(num_leaves=1, shrinkage=1.0)
+                    tree.leaf_value = np.array([bias], np.float64)
+                self._models.append(tree)
+
     def train_one_iter(
         self, grad: Optional[np.ndarray] = None, hess: Optional[np.ndarray] = None
     ) -> bool:
         """One boosting iteration; returns True when training should stop
         (no splittable leaf), matching GBDT::TrainOneIter (gbdt.cpp:352)."""
+        if self._stopped:
+            return True
+        # leaf-renewal objectives (l1/huber/quantile/mape) need per-iter
+        # host work even under a custom fobj (the reference's
+        # UpdateOneIterCustom still calls RenewTreeOutput)
+        fast = not self._force_sync and (
+            self.objective is None or not self.objective.is_renew_tree_output
+        ) and (grad is not None or self.objective is not None)
+        if fast:
+            return self._train_one_iter_fast(grad, hess)
+        return self._train_one_iter_sync(grad, hess)
+
+    def _prepare_gradients(self, grad, hess):
+        """Shared per-iteration prep: boost-from-average on the first
+        iteration (gbdt.cpp:327), then objective gradients at the current
+        score — or padding of caller-supplied custom grad/hess.
+        Returns (grad_dev (K, Np), hess_dev (K, Np), init_scores)."""
         import jax.numpy as jnp
 
         K = self.num_class
         ds = self.train_set
         init_scores = [0.0] * K
-
         if grad is None or hess is None:
             if self.objective is None:
                 log.fatal("custom objective requires explicit grad/hess")
-            # boost from average (first iteration only)
             if (
-                not self.models
+                not self._models
+                and not self._pending
                 and self.config.boost_from_average
                 and not self.has_init_score
             ):
@@ -170,6 +284,77 @@ class GBDT:
             gp[:, : ds.num_data] = grad
             hp[:, : ds.num_data] = hess
             grad_dev, hess_dev = jnp.asarray(gp), jnp.asarray(hp)
+        return grad_dev, hess_dev, init_scores
+
+    def _train_one_iter_fast(
+        self, grad: Optional[np.ndarray] = None, hess: Optional[np.ndarray] = None
+    ) -> bool:
+        """Sync-free iteration: no device->host reads; host trees and the
+        stop check are deferred to _materialize()."""
+        import jax
+        import jax.numpy as jnp
+
+        K = self.num_class
+        grad_dev, hess_dev, init_scores = self._prepare_gradients(grad, hess)
+
+        one = jnp.float32(1.0)
+        for k in range(K):
+            gk, hk = grad_dev[k], hess_dev[k]
+            mask, gk, hk = self.strategy.sample(
+                self.iter_, gk, hk, self.dev["valid"], self._label_dev
+            )
+            feat_mask = self._sample_features()
+            arrays, row_leaf = grow_tree(
+                self.dev["bins"],
+                self.dev["nan_bin"],
+                self.dev["num_bins"],
+                self.dev["mono"],
+                self.dev["is_cat"],
+                gk,
+                hk,
+                mask,
+                feat_mask,
+                self.params,
+                self.spec,
+                valid=self.dev["valid"],
+            )
+            ok = (arrays.num_nodes > 0).astype(jnp.float32)
+            lv = arrays.leaf_value * (self.shrinkage_rate * ok)
+            if abs(init_scores[k]) > 1e-15:
+                # AddBias (gbdt.cpp:424-426): stored trees carry the
+                # boost-from-average bias; score got it at BoostFromAverage
+                lv = lv + init_scores[k] * ok
+            arrays = arrays._replace(leaf_value=lv)
+            self.train.score = self.train.score.at[k].set(
+                add_score(self.train.score[k], row_leaf, lv, one)
+            )
+            for vs in self.valids:
+                vdev = vs.dataset.device_arrays()
+                leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                vs.score = vs.score.at[k].set(
+                    add_score(vs.score[k], leaf, lv, one)
+                )
+            self.device_trees.append((arrays, None))
+            self._pending.append(arrays)
+            self._pending_meta.append((k, init_scores[k], self.shrinkage_rate))
+            # start the device->host copies now so _materialize is ~free
+            jax.tree.map(lambda a: a.copy_to_host_async(), arrays)
+
+        self.iter_ += 1
+        if self.iter_ % self._check_every == 0:
+            self._materialize()
+            return self._stopped
+        return False
+
+    def _train_one_iter_sync(
+        self, grad: Optional[np.ndarray] = None, hess: Optional[np.ndarray] = None
+    ) -> bool:
+        import jax.numpy as jnp
+
+        K = self.num_class
+        ds = self.train_set
+        self._materialize()  # keep model list ordering if modes ever mix
+        grad_dev, hess_dev, init_scores = self._prepare_gradients(grad, hess)
 
         should_continue = False
         for k in range(K):
@@ -532,6 +717,7 @@ class DART(GBDT):
 
     def __init__(self, config: Config, train_set: Optional[BinnedDataset]):
         super().__init__(config, train_set)
+        self._force_sync = True  # dropout mutates past trees every iter
         self._drop_rng = np.random.RandomState(config.drop_seed)
         self._tree_weight: List[float] = []  # per-iteration weights
         self._sum_weight = 0.0
@@ -674,6 +860,7 @@ class RF(GBDT):
                         "or feature_fraction in (0,1)"
                     )
         super().__init__(config, train_set)
+        self._force_sync = True  # per-iter running-average score updates
         self.average_output = True
         self.shrinkage_rate = 1.0
         if train_set is None:

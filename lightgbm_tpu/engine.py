@@ -97,6 +97,9 @@ def train(
         if finished:
             break
 
+    # flush the async training pipeline (fast-path pending device trees)
+    booster._gbdt._materialize()
+
     # record best score
     for item in evaluation_result_list or []:
         booster.best_score.setdefault(item[0], collections.OrderedDict())

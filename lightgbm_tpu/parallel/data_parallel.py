@@ -84,6 +84,18 @@ class DataParallelGrower:
 
     def shard_inputs(self, dev: dict) -> dict:
         """device_put the dataset arrays with the right shardings."""
+        from ..learner.histogram import HIST_BLK
+
+        n_dev = self.mesh.devices.size
+        n_rows = dev["bins"].shape[0]
+        if (n_rows // n_dev) % HIST_BLK != 0:
+            from .. import log
+
+            log.warning(
+                f"per-shard rows ({n_rows}/{n_dev}) are not a multiple of the "
+                f"pallas histogram block ({HIST_BLK}); histograms will use the "
+                f"slow einsum fallback — pad rows to row_block*num_devices"
+            )
         row = NamedSharding(self.mesh, P(self.axis_name))
         rep = NamedSharding(self.mesh, P())
         out = dict(dev)

@@ -79,3 +79,34 @@ def test_early_stopping():
     )
     assert bst.best_iteration > 0
     assert bst.best_iteration <= 200
+
+
+def test_constant_label_keeps_bias_tree():
+    """All-stump first iteration: the boost-from-average constant tree
+    survives the async pipeline's stop detection (gbdt.cpp:429-441)."""
+    X = np.random.RandomState(0).randn(600, 4)
+    y = np.full(600, 3.5)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 7, "verbosity": -1},
+        ds, num_boost_round=5,
+    )
+    assert bst.num_trees() == 1
+    np.testing.assert_allclose(bst.predict(X[:3]), 3.5, rtol=1e-6)
+
+
+def test_training_stops_when_unsplittable():
+    """min_data_in_leaf too large for any split after a few iterations ->
+    training truncates at the first dead iteration, and the model equals
+    its own score (predictions consistent)."""
+    X, y = make_synthetic_regression(300, 5)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "min_data_in_leaf": 160},  # only the root has >= 160 rows... no split
+        ds, num_boost_round=60,
+    )
+    # boost-from-average constant tree only
+    assert bst.num_trees() <= 1
+    pred = bst.predict(X)
+    np.testing.assert_allclose(pred, np.mean(y), rtol=1e-5)
