@@ -278,10 +278,10 @@ class BinnedDataset:
         """Push the bin matrix + per-feature info to device (cached).
 
         Returns dict with:
-          bins      (Np, F) int32 — row-major bin matrix, rows padded
+          bins      (F, Np) int32 — feature-major bin matrix, rows padded
                     with bin 0 to a row_block multiple; rows ride the
-                    sublane axis so the pallas histogram kernel's
-                    one-hot compare needs no relayout
+                    LANE axis (TPU memory tiles pad the minor-most dim to
+                    128, so the long axis must be last)
           valid     (Np,)  float32  — 1.0 for real rows, 0.0 for padding
           nan_bin   (F,)   int32    — NaN bin index per feature, -1 if none
           num_bins  (F,)   int32    — per-feature bin count
@@ -294,8 +294,8 @@ class BinnedDataset:
 
         npad = self.num_rows_padded()
         f = self.num_used_features
-        bins_rm = np.zeros((npad, f), dtype=np.int32)
-        bins_rm[: self.num_data, :] = self.bins.T
+        bins_fm = np.zeros((f, npad), dtype=np.int32)
+        bins_fm[:, : self.num_data] = self.bins
         um = self.used_mappers()
         nan_bin = np.array([m.nan_bin for m in um], dtype=np.int32)
         num_bins = np.array([m.num_bin for m in um], dtype=np.int32)
@@ -308,7 +308,7 @@ class BinnedDataset:
         valid = np.zeros(npad, dtype=np.float32)
         valid[: self.num_data] = 1.0
         self._device = {
-            "bins": jnp.asarray(bins_rm),
+            "bins": jnp.asarray(bins_fm),
             "valid": jnp.asarray(valid),
             "nan_bin": jnp.asarray(nan_bin),
             "num_bins": jnp.asarray(num_bins),

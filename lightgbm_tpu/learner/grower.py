@@ -55,6 +55,10 @@ class GrowerSpec(NamedTuple):
     # instead of N (the reference's index-list construction,
     # data_partition.hpp); False = masked full scans (simpler, for debug)
     gather_hist: bool = True
+    # "permuted": physically leaf-grouped rows, O(segment) per split
+    # (permuted.py — the production path); "flat": per-row leaf-id vector,
+    # O(N) per split (kept as the reference/debug implementation)
+    partition: str = "permuted"
 
 
 class TreeArrays(NamedTuple):
@@ -139,9 +143,8 @@ def _get_best(best: SplitRecord, l: jax.Array) -> SplitRecord:
     return jax.tree.map(lambda a: a[l], best)
 
 
-@partial(jax.jit, static_argnames=("spec",))
 def grow_tree(
-    bins_rm: jax.Array,  # (N, F) int32 — row-major bin matrix
+    bins_fm: jax.Array,  # (F, N) int32 — feature-major bin matrix
     nan_bin: jax.Array,  # (F,)
     num_bins: jax.Array,  # (F,)
     mono: jax.Array,  # (F,)
@@ -156,6 +159,39 @@ def grow_tree(
 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (tree arrays, per-row leaf assignment).
 
+    Dispatches on spec.partition: "permuted" (leaf-grouped rows,
+    O(segment) per split — production) or "flat" (per-row leaf ids,
+    O(N) per split — reference/debug)."""
+    if spec.partition == "permuted":
+        from .permuted import grow_tree_permuted
+
+        return grow_tree_permuted(
+            bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+            feat_mask, params, spec, valid
+        )
+    return _grow_tree_flat(
+        bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+        feat_mask, params, spec, valid
+    )
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _grow_tree_flat(
+    bins_fm: jax.Array,
+    nan_bin: jax.Array,
+    num_bins: jax.Array,
+    mono: jax.Array,
+    is_cat: jax.Array,
+    grad: jax.Array,
+    hess: jax.Array,
+    mask: jax.Array,
+    feat_mask: jax.Array,
+    params: SplitParams,
+    spec: GrowerSpec,
+    valid: Optional[jax.Array] = None,
+) -> Tuple[TreeArrays, jax.Array]:
+    """Flat row->leaf-id formulation (cuda_data_partition.cu style).
+
     Padding rows (valid == 0) carry leaf id -1 so they never join a leaf
     or occupy gather capacity; out-of-bag rows (mask 0 but valid 1) are
     partitioned normally for score updates but contribute zero to
@@ -163,19 +199,19 @@ def grow_tree(
     """
     L = spec.num_leaves
     B = spec.num_bins
-    N, F = bins_rm.shape
+    F, N = bins_fm.shape
     ax = spec.axis_name
     caps = hist_capacities(N)
 
     gh8 = build_gh8(grad * mask, hess * mask, mask)  # (8, N)
     root = root_sums(gh8, ax)
 
-    hist0 = histogram(bins_rm, gh8, B)
+    hist0 = histogram(bins_fm, gh8, B)
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
     rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin, mono, is_cat, params, feat_mask)
 
-    hist = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist0)
+    hist = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
     best = _set_best(_empty_best(L), jnp.int32(0), rec0, rec0.gain)
 
     tree = TreeArrays(
@@ -260,7 +296,7 @@ def grow_tree(
 
         # ---- partition: update per-row leaf ids (cuda_data_partition.cu) ----
         f = rec.feature
-        fbins = lax.dynamic_slice_in_dim(bins_rm, f, 1, axis=1).reshape(N)
+        fbins = lax.dynamic_slice_in_dim(bins_fm, f, 1, axis=0).reshape(N)
         fnan = nan_bin[f]
         go_left = jnp.where(
             rec.is_cat,
@@ -293,7 +329,7 @@ def grow_tree(
             def mk_branch(cap: int):
                 def branch(_):
                     idx = jnp.nonzero(on_small, size=cap, fill_value=N)[0]
-                    bb = gather_rows(bins_rm, idx)  # (cap, F)
+                    bb = gather_rows(bins_fm, idx)  # (F, cap)
                     gg = gather_gh8(gh8, idx)  # (8, cap)
                     return histogram(bb, gg, B)
 
@@ -313,7 +349,7 @@ def grow_tree(
             small_hist = lax.switch(bidx, branches, None)
         else:
             on_small_f = (row_leaf == small_id).astype(gh8.dtype)
-            small_hist = histogram(bins_rm, gh8 * on_small_f[None, :], B)
+            small_hist = histogram(bins_fm, gh8 * on_small_f[None, :], B)
         if ax is not None:
             small_hist = lax.psum(small_hist, ax)
         large_hist = parent_hist - small_hist

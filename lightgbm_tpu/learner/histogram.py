@@ -13,15 +13,16 @@ contraction. Two backends share one data layout:
 - **XLA einsum fallback** (CPU tests, virtual meshes, odd row counts):
   same math, one-hot materialized per small row block under `lax.scan`.
 
-Layout: bins are row-major `(N, F)` int32 (rows on sublanes — the
-pallas kernel's one-hot compare then needs no lane->sublane relayout);
-per-row channels are `(8, N)` f32 rows `(g_hi, g_lo, h_hi, h_lo, count,
-0, 0, 0)`. The bf16x2 split (hi = bf16(x), lo = x - hi) lets the MXU run
-in bf16 while the recombined histogram keeps ~f32 accuracy — the padded
-channel slots are free because the matmul M dim pads 3 -> 8 anyway.
-Gradient/hessian are summed per bin exactly like the reference's f64
-histograms (hist_t), at float precision like its GPU path (gpu_hist_t,
-docs/GPU-Performance.rst accuracy table).
+Layouts put the LONG (row) axis minor-most everywhere — TPU memory
+tiles pad the last dim to 128 lanes, so a row-major (N, 28) bin matrix
+would physically occupy 4.5x its nominal bytes. Hence: bins are
+feature-major `(F, N)` int32; per-row channels `(8, N)` f32 with rows
+`(g_hi, g_lo, h_hi, h_lo, count, 0, 0, 0)`; histograms are `(3, F, B)`
+(channel leading, bins on lanes). The bf16x2 split (hi = bf16(x),
+lo = x - hi) lets the MXU run in bf16 while the recombined histogram
+keeps ~f32 accuracy — the padded channel slots are free because the
+matmul M dim pads 3 -> 8 anyway. Gradient/hessian sums per bin are f32
+like the reference's GPU path (gpu_hist_t, docs/GPU-Performance.rst).
 """
 
 from __future__ import annotations
@@ -54,58 +55,55 @@ def build_gh8(grad: jax.Array, hess: jax.Array, count: jax.Array) -> jax.Array:
 
 
 def combine_ch(hist8: jax.Array) -> jax.Array:
-    """(F, CH, B) accumulated channels -> (F, B, 3) (grad, hess, count)."""
-    g = hist8[:, 0, :] + hist8[:, 1, :]
-    h = hist8[:, 2, :] + hist8[:, 3, :]
-    c = hist8[:, 4, :]
-    return jnp.stack([g, h, c], axis=-1)
+    """(CH, F, B) accumulated channels -> (3, F, B) (grad, hess, count)."""
+    return jnp.stack(
+        [hist8[0] + hist8[1], hist8[2] + hist8[3], hist8[4]]
+    )
 
 
-def _hist_fallback(bins_rm: jax.Array, gh8: jax.Array, num_bins: int,
+def _hist_fallback(bins_fm: jax.Array, gh8: jax.Array, num_bins: int,
                    blk: int = 512) -> jax.Array:
     """One-hot einsum under lax.scan; any N (pads to a block multiple)."""
-    N, F = bins_rm.shape
-    gh3 = jnp.stack(
-        [gh8[0] + gh8[1], gh8[2] + gh8[3], gh8[4]], axis=-1
-    )  # (N, 3)
+    F, N = bins_fm.shape
+    gh3 = jnp.stack([gh8[0] + gh8[1], gh8[2] + gh8[3], gh8[4]])  # (3, N)
     if N % blk != 0:
         pad = blk - N % blk
-        bins_rm = jnp.pad(bins_rm, ((0, pad), (0, 0)))
-        gh3 = jnp.pad(gh3, ((0, pad), (0, 0)))
+        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, pad)))
+        gh3 = jnp.pad(gh3, ((0, 0), (0, pad)))
         N += pad
     nb = N // blk
-    bb = bins_rm.reshape(nb, blk, F)
-    gg = gh3.reshape(nb, blk, 3)
-    iota = jnp.arange(num_bins, dtype=bins_rm.dtype)
+    bb = bins_fm.reshape(F, nb, blk).transpose(1, 0, 2)  # (nb, F, blk)
+    gg = gh3.reshape(3, nb, blk).transpose(1, 0, 2)  # (nb, 3, blk)
+    iota = jnp.arange(num_bins, dtype=bins_fm.dtype)
 
     def body(acc, xs):
-        b, g = xs  # (blk, F), (blk, 3)
-        onehot = (b[:, :, None] == iota).astype(jnp.float32)  # (blk, F, B)
+        b, g = xs  # (F, blk), (3, blk)
+        onehot = (b[:, :, None] == iota).astype(jnp.float32)  # (F, blk, B)
         acc = acc + jnp.einsum(
-            "rfb,rc->fbc", onehot, g, preferred_element_type=jnp.float32
+            "frb,cr->cfb", onehot, g, preferred_element_type=jnp.float32
         )
         return acc, None
 
-    init = jnp.zeros((F, num_bins, 3), dtype=jnp.float32)
+    init = jnp.zeros((3, F, num_bins), dtype=jnp.float32)
     hist, _ = lax.scan(body, init, (bb, gg))
     return hist
 
 
-def histogram(bins_rm: jax.Array, gh8: jax.Array, num_bins: int) -> jax.Array:
-    """(N, F) int32 bins + (8, N) channels -> (F, B, 3) f32 histogram."""
-    N, F = bins_rm.shape
+def histogram(bins_fm: jax.Array, gh8: jax.Array, num_bins: int) -> jax.Array:
+    """(F, N) int32 bins + (8, N) channels -> (3, F, B) f32 histogram."""
+    F, N = bins_fm.shape
     if _use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK:
         from .pallas_hist import hist_tpu
 
-        return combine_ch(hist_tpu(bins_rm, gh8, num_bins))
-    return _hist_fallback(bins_rm, gh8, num_bins)
+        return combine_ch(hist_tpu(bins_fm, gh8, num_bins))
+    return _hist_fallback(bins_fm, gh8, num_bins)
 
 
-def gather_rows(bins_rm: jax.Array, idx: jax.Array) -> jax.Array:
-    """Gather rows by index -> (len(idx), F). Out-of-range idx (pad
-    slots) fill with bin 0; callers zero their gh so those rows
+def gather_rows(bins_fm: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather rows (lane axis) by index -> (F, len(idx)). Out-of-range
+    idx (pad slots) fill with bin 0; callers zero their gh so those rows
     contribute nothing."""
-    return jnp.take(bins_rm, idx, axis=0, mode="fill", fill_value=0)
+    return jnp.take(bins_fm, idx, axis=1, mode="fill", fill_value=0)
 
 
 def gather_gh8(gh8: jax.Array, idx: jax.Array) -> jax.Array:

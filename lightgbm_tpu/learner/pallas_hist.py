@@ -8,18 +8,20 @@ a one-hot contraction — but unlike a plain XLA einsum, the one-hot
 matrix only ever exists one (HIST_BLK, B) tile at a time in VMEM,
 never in HBM. Per grid step (one row block):
 
-    bins tile (blk, F) int32, gh tile (8, blk) f32    -> VMEM
+    bins tile (F, blk) int32, gh tile (8, blk) f32    -> VMEM
+    bt = transpose(bins tile)                          (blk, F), one relayout
     for each feature f (static unroll):
-        onehot = (bins[:, f:f+1] == iota_B)            (blk, B) bf16
+        onehot = (bt[:, f:f+1] == iota_B)              (blk, B) bf16
         acc[:, f*B:(f+1)*B] += gh @ onehot             MXU (8,blk)@(blk,B)
     last step: out = acc
 
-Rows ride the sublane axis of the bins tile so the one-hot compare
-broadcasts along lanes with no relayout. The channel axis is padded
-3 -> 8 (bf16x2-split grad/hess + count, see histogram.build_gh8) to
-match the f32 sublane tile; f32 accumulation into a (8, F*B) VMEM
-scratch across grid steps. HBM traffic per call is one read of the bin
-matrix + channels.
+Inputs are feature-major (rows on the LANE axis) because TPU memory
+tiles pad the minor-most dim to 128 lanes — a row-major (N, 28) matrix
+would physically occupy 4.5x its size in HBM. One in-kernel transpose
+per tile puts rows on sublanes for the one-hot compare. The channel
+axis is padded 3 -> 8 (bf16x2-split grad/hess + count, see
+histogram.build_gh8) to match the f32 sublane tile; f32 accumulation
+into a (8, F*B) VMEM scratch across grid steps.
 """
 
 from __future__ import annotations
@@ -42,7 +44,7 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, acc_ref, *, F: int, B: int, blk: int
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    bt = bins_ref[...]  # (blk, F) int32
+    bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
     g = gh_ref[...].astype(jnp.bfloat16)  # (CH, blk)
     iota = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
     for f in range(F):
@@ -58,13 +60,13 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, acc_ref, *, F: int, B: int, blk: int
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "blk"))
 def hist_tpu(
-    bins_rm: jax.Array, gh8: jax.Array, num_bins: int, blk: int = HIST_BLK
+    bins_fm: jax.Array, gh8: jax.Array, num_bins: int, blk: int = HIST_BLK
 ) -> jax.Array:
-    """(N, F) int32 bins + (CH, N) f32 channels -> (F, CH, B) f32.
+    """(F, N) int32 bins + (CH, N) f32 channels -> (CH, F, B) f32.
 
     N must be a multiple of blk; callers pad rows with gh == 0.
     """
-    N, F = bins_rm.shape
+    F, N = bins_fm.shape
     assert N % blk == 0, (N, blk)
     assert gh8.shape == (CH, N), gh8.shape
     B = num_bins
@@ -74,11 +76,11 @@ def hist_tpu(
         functools.partial(_hist_kernel, F=F, B=B, blk=blk),
         grid=(nb,),
         in_specs=[
-            pl.BlockSpec((blk, F), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((F, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((CH, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((CH, F * B), lambda i: (0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((CH, F * B), jnp.float32),
         scratch_shapes=[pltpu.VMEM((CH, F * B), jnp.float32)],
-    )(bins_rm, gh8)
-    return out.reshape(CH, F, B).transpose(1, 0, 2)  # (F, CH, B)
+    )(bins_fm, gh8)
+    return out.reshape(CH, F, B)

@@ -1,0 +1,328 @@
+"""Leaf-wise tree growth over a physically permuted bin matrix.
+
+This is the TPU formulation of the reference's index-list partition
+(src/treelearner/data_partition.hpp: rows stored grouped by leaf as one
+permuted array + per-leaf (begin, count)): the bin matrix, channel
+matrix, and a row-origin vector are kept PHYSICALLY reordered so every
+leaf occupies a contiguous segment. Each split then costs O(parent
+segment), not O(N):
+
+- stable partition of the parent segment (ParallelPartitionRunner /
+  cuda_data_partition.cu SplitInner): two `nonzero` compactions over a
+  static-capacity slice + one gather + one dynamic_update_slice;
+- the smaller child's histogram reads a CONTIGUOUS slice (no row
+  gather, no full-N mask), the larger sibling comes from parent
+  subtraction as in serial_tree_learner.cpp:411;
+- total per-tree work matches the reference's sum-of-segment-sizes
+  (~depth x N), where the flat row->leaf formulation pays O(N) per
+  split (254x N for a 255-leaf tree).
+
+Static shapes come from a capacity ladder (N, N/2, ..., HIST_BLK):
+every segment operation runs at the smallest capacity that covers the
+segment, with rows outside the segment masked / passed through
+untouched.
+
+With `axis_name` set, rows are sharded; histograms and the
+smaller-child choice are psum'd (data_parallel_tree_learner.cpp:286)
+while each shard stable-partitions its local segment in lockstep.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .histogram import HIST_BLK, build_gh8, histogram, root_sums
+from .split import NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
+from .grower import (
+    GrowerSpec,
+    TreeArrays,
+    _empty_best,
+    _get_best,
+    _set_best,
+)
+
+
+def segment_caps(n_rows: int) -> tuple:
+    """Static ladder of segment capacities: N, N/2, ..., >= HIST_BLK,
+    all HIST_BLK multiples (n_rows itself must already be one)."""
+    caps = []
+    c = n_rows
+    while c >= HIST_BLK:
+        caps.append(((c + HIST_BLK - 1) // HIST_BLK) * HIST_BLK)
+        c //= 2
+    if not caps:
+        caps.append(n_rows)
+    return tuple(caps)
+
+
+class _PState(NamedTuple):
+    i: jax.Array
+    pbins: jax.Array  # (F, N) int32, leaf-grouped along the row (lane) axis
+    pgh: jax.Array  # (8, N) f32, leaf-grouped (build_gh8 channels)
+    pperm: jax.Array  # (N,) int32 — original row index at each position
+    seg_begin: jax.Array  # (L,) int32; unused leaves = N (sorts last)
+    seg_count: jax.Array  # (L,) int32
+    hist: jax.Array  # (L, F, B, 3)
+    leaf_g: jax.Array
+    leaf_h: jax.Array
+    leaf_c: jax.Array
+    leaf_parent: jax.Array
+    best: SplitRecord
+    tree: TreeArrays
+
+
+def _go_left(fbins, rec, fnan):
+    return jnp.where(
+        rec.is_cat,
+        fbins == rec.bin,
+        (fbins <= rec.bin) | (rec.default_left & (fbins == fnan) & (fnan >= 0)),
+    )
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def grow_tree_permuted(
+    bins_fm: jax.Array,  # (F, N) int32
+    nan_bin: jax.Array,
+    num_bins: jax.Array,
+    mono: jax.Array,
+    is_cat: jax.Array,
+    grad: jax.Array,
+    hess: jax.Array,
+    mask: jax.Array,  # validity * bagging
+    feat_mask: jax.Array,
+    params: SplitParams,
+    spec: GrowerSpec,
+    valid: Optional[jax.Array] = None,
+) -> Tuple[TreeArrays, jax.Array]:
+    """Grow one tree; returns (tree arrays, natural-order row->leaf)."""
+    L = spec.num_leaves
+    B = spec.num_bins
+    F, N = bins_fm.shape
+    ax = spec.axis_name
+    caps = segment_caps(N)
+
+    gh8 = build_gh8(grad * mask, hess * mask, mask)  # (8, N)
+    root = root_sums(gh8, ax)
+
+    hist0 = histogram(bins_fm, gh8, B)
+    if ax is not None:
+        hist0 = lax.psum(hist0, ax)
+    rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin,
+                      mono, is_cat, params, feat_mask)
+
+    hist = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
+    best = _set_best(_empty_best(L), jnp.int32(0), rec0, rec0.gain)
+
+    tree = TreeArrays(
+        num_nodes=jnp.int32(0),
+        node_feature=jnp.zeros(L - 1, jnp.int32),
+        node_bin=jnp.zeros(L - 1, jnp.int32),
+        node_gain=jnp.zeros(L - 1, jnp.float32),
+        node_default_left=jnp.zeros(L - 1, bool),
+        node_cat=jnp.zeros(L - 1, bool),
+        node_left=jnp.zeros(L - 1, jnp.int32),
+        node_right=jnp.zeros(L - 1, jnp.int32),
+        node_value=jnp.zeros(L - 1, jnp.float32),
+        node_weight=jnp.zeros(L - 1, jnp.float32),
+        node_count=jnp.zeros(L - 1, jnp.float32),
+        leaf_value=jnp.zeros(L, jnp.float32).at[0].set(leaf_output(root[0], root[1], params)),
+        leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
+        leaf_count=jnp.zeros(L, jnp.float32).at[0].set(root[2]),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+    )
+
+    valid_f = jnp.ones(N, jnp.float32) if valid is None else valid
+    n_valid = jnp.sum(valid_f > 0).astype(jnp.int32)  # local (shard) count
+
+    state = _PState(
+        i=jnp.int32(0),
+        pbins=bins_fm,
+        pgh=gh8,
+        pperm=jnp.arange(N, dtype=jnp.int32),
+        seg_begin=jnp.full(L, N, jnp.int32).at[0].set(0),
+        seg_count=jnp.zeros(L, jnp.int32).at[0].set(n_valid),
+        hist=hist,
+        leaf_g=jnp.zeros(L, jnp.float32).at[0].set(root[0]),
+        leaf_h=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
+        leaf_c=jnp.zeros(L, jnp.float32).at[0].set(root[2]),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        best=best,
+        tree=tree,
+    )
+
+    def cond(s: _PState) -> jax.Array:
+        return (s.i < L - 1) & (jnp.max(s.best.gain) > 0.0)
+
+    def body(s: _PState) -> _PState:
+        i = s.i
+        t = s.tree
+        l = jnp.argmax(s.best.gain).astype(jnp.int32)
+        rec = _get_best(s.best, l)
+        new = i + 1
+
+        # ---- tree bookkeeping (Tree::Split semantics, same as flat) ----
+        p = s.leaf_parent[l]
+        pc = jnp.maximum(p, 0)
+        p_is_left = t.node_left[pc] == ~l
+        node_left = t.node_left.at[pc].set(
+            jnp.where((p >= 0) & p_is_left, i, t.node_left[pc])
+        )
+        node_right = t.node_right.at[pc].set(
+            jnp.where((p >= 0) & ~p_is_left, i, t.node_right[pc])
+        )
+        node_left = node_left.at[i].set(~l)
+        node_right = node_right.at[i].set(~new)
+
+        lo = leaf_output(rec.left_g, rec.left_h, params)
+        ro = leaf_output(rec.right_g, rec.right_h, params)
+        depth_new = t.leaf_depth[l] + 1
+
+        tree_new = TreeArrays(
+            num_nodes=new,
+            node_feature=t.node_feature.at[i].set(rec.feature),
+            node_bin=t.node_bin.at[i].set(rec.bin),
+            node_gain=t.node_gain.at[i].set(rec.gain),
+            node_default_left=t.node_default_left.at[i].set(rec.default_left),
+            node_cat=t.node_cat.at[i].set(rec.is_cat),
+            node_left=node_left,
+            node_right=node_right,
+            node_value=t.node_value.at[i].set(leaf_output(s.leaf_g[l], s.leaf_h[l], params)),
+            node_weight=t.node_weight.at[i].set(s.leaf_h[l]),
+            node_count=t.node_count.at[i].set(s.leaf_c[l]),
+            leaf_value=t.leaf_value.at[l].set(lo).at[new].set(ro),
+            leaf_weight=t.leaf_weight.at[l].set(rec.left_h).at[new].set(rec.right_h),
+            leaf_count=t.leaf_count.at[l].set(rec.left_c).at[new].set(rec.right_c),
+            leaf_depth=t.leaf_depth.at[l].set(depth_new).at[new].set(depth_new),
+        )
+
+        b = s.seg_begin[l]
+        c = s.seg_count[l]
+        fnan = nan_bin[rec.feature]
+
+        # ---- stable partition of segment [b, b+c) at capacity cap ----
+        def mk_part(cap: int):
+            def part(_):
+                start = jnp.clip(b, 0, N - cap)
+                off = b - start
+                sbins = lax.dynamic_slice(s.pbins, (jnp.int32(0), start), (F, cap))
+                sgh = lax.dynamic_slice(s.pgh, (jnp.int32(0), start), (8, cap))
+                sperm = lax.dynamic_slice(s.pperm, (start,), (cap,))
+                iota = jnp.arange(cap, dtype=jnp.int32)
+                in_seg = (iota >= off) & (iota < off + c)
+                fcol = lax.dynamic_slice(
+                    sbins, (rec.feature, jnp.int32(0)), (1, cap)
+                ).reshape(cap)
+                gl = _go_left(fcol, rec, fnan)
+                sel_l = in_seg & gl
+                n_l = jnp.sum(sel_l).astype(jnp.int32)
+                lidx = jnp.nonzero(sel_l, size=cap, fill_value=cap)[0]
+                ridx = jnp.nonzero(in_seg & ~gl, size=cap, fill_value=cap)[0]
+                rel = iota - off
+                src = jnp.where(
+                    rel < n_l,
+                    jnp.take(lidx, jnp.clip(rel, 0, cap - 1), mode="clip"),
+                    jnp.take(ridx, jnp.clip(rel - n_l, 0, cap - 1), mode="clip"),
+                )
+                src = jnp.where(in_seg, src, iota)
+                nb = jnp.take(sbins, src, axis=1, mode="clip")
+                ng = jnp.take(sgh, src, axis=1, mode="clip")
+                npm = jnp.take(sperm, src, mode="clip")
+                pbins = lax.dynamic_update_slice(s.pbins, nb, (jnp.int32(0), start))
+                pgh = lax.dynamic_update_slice(s.pgh, ng, (jnp.int32(0), start))
+                pperm = lax.dynamic_update_slice(s.pperm, npm, (start,))
+                return pbins, pgh, pperm, n_l
+
+            return part
+
+        caps_arr = jnp.asarray(caps, jnp.int32)
+        pidx = jnp.clip(jnp.sum(caps_arr >= c) - 1, 0, len(caps) - 1)
+        pbins, pgh, pperm, n_l = lax.switch(
+            pidx, [mk_part(cp) for cp in caps], None
+        )
+        n_r = c - n_l
+
+        # ---- children segments; smaller child by GLOBAL count ----
+        if ax is not None:
+            left_smaller = lax.psum(n_l, ax) <= lax.psum(n_r, ax)
+        else:
+            left_smaller = n_l <= n_r
+        # left child keeps leaf id l at [b, b+n_l); right child (id `new`)
+        # occupies [b+n_l, b+c)
+        seg_begin = s.seg_begin.at[l].set(b).at[new].set(b + n_l)
+        seg_count = s.seg_count.at[l].set(n_l).at[new].set(n_r)
+
+        small_begin = jnp.where(left_smaller, b, b + n_l)
+        small_cnt = jnp.where(left_smaller, n_l, n_r)
+
+        # ---- smaller-child histogram over its contiguous slice ----
+        def mk_hist(cap: int):
+            def h(_):
+                start = jnp.clip(small_begin, 0, N - cap)
+                off = small_begin - start
+                hb = lax.dynamic_slice(pbins, (jnp.int32(0), start), (F, cap))
+                hg = lax.dynamic_slice(pgh, (jnp.int32(0), start), (8, cap))
+                iota = jnp.arange(cap, dtype=jnp.int32)
+                m = ((iota >= off) & (iota < off + small_cnt)).astype(jnp.float32)
+                return histogram(hb, hg * m[None, :], B)
+
+            return h
+
+        hidx = jnp.clip(jnp.sum(caps_arr >= small_cnt) - 1, 0, len(caps) - 1)
+        small_hist = lax.switch(hidx, [mk_hist(cp) for cp in caps], None)
+        if ax is not None:
+            small_hist = lax.psum(small_hist, ax)
+
+        parent_hist = s.hist[l]
+        large_hist = parent_hist - small_hist
+        left_hist = jnp.where(left_smaller, small_hist, large_hist)
+        right_hist = jnp.where(left_smaller, large_hist, small_hist)
+        hist = s.hist.at[l].set(left_hist).at[new].set(right_hist)
+
+        # ---- best splits for both children ----
+        bl = best_split(left_hist, rec.left_g, rec.left_h, rec.left_c,
+                        num_bins, nan_bin, mono, is_cat, params, feat_mask)
+        br = best_split(right_hist, rec.right_g, rec.right_h, rec.right_c,
+                        num_bins, nan_bin, mono, is_cat, params, feat_mask)
+        depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
+        best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
+        best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))
+
+        return _PState(
+            i=new,
+            pbins=pbins,
+            pgh=pgh,
+            pperm=pperm,
+            seg_begin=seg_begin,
+            seg_count=seg_count,
+            hist=hist,
+            leaf_g=s.leaf_g.at[l].set(rec.left_g).at[new].set(rec.right_g),
+            leaf_h=s.leaf_h.at[l].set(rec.left_h).at[new].set(rec.right_h),
+            leaf_c=s.leaf_c.at[l].set(rec.left_c).at[new].set(rec.right_c),
+            leaf_parent=s.leaf_parent.at[l].set(i).at[new].set(i),
+            best=best2,
+            tree=tree_new,
+        )
+
+    final = lax.while_loop(cond, body, state)
+
+    # ---- natural-order row -> leaf from the leaf segments ----
+    # order leaves by segment begin (unused slots and locally-EMPTY
+    # leaves — possible on a shard — get begin == N so they sort last
+    # and never shadow a sibling sharing their begin); position p then
+    # belongs to the last leaf with begin <= p
+    eff_begin = jnp.where(final.seg_count > 0, final.seg_begin, N)
+    order = jnp.argsort(eff_begin)
+    sorted_begin = eff_begin[order]
+    pos = jnp.arange(N, dtype=jnp.int32)
+    leaf_of_pos = order[
+        jnp.clip(jnp.searchsorted(sorted_begin, pos, side="right") - 1, 0, L - 1)
+    ].astype(jnp.int32)
+    row_leaf = jnp.zeros(N, jnp.int32).at[final.pperm].set(leaf_of_pos)
+    if valid is not None:
+        row_leaf = jnp.where(valid > 0, row_leaf, -1)
+    return final.tree, row_leaf

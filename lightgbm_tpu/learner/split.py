@@ -88,7 +88,7 @@ def leaf_gain(g: jax.Array, h: jax.Array, p: SplitParams) -> jax.Array:
 
 
 def best_split(
-    hist: jax.Array,  # (F, B, 3) f32
+    hist: jax.Array,  # (3, F, B) f32 — (grad, hess, count) channels
     sum_g: jax.Array,
     sum_h: jax.Array,
     sum_c: jax.Array,
@@ -100,10 +100,10 @@ def best_split(
     feat_mask: Optional[jax.Array] = None,  # (F,) bool — ColSampler feature_fraction
 ) -> SplitRecord:
     """Find the best split of a leaf with given histogram and totals."""
-    F, B, _ = hist.shape
-    g = hist[:, :, 0]
-    h = hist[:, :, 1]
-    c = hist[:, :, 2]
+    _, F, B = hist.shape
+    g = hist[0]
+    h = hist[1]
+    c = hist[2]
     bin_idx = jnp.arange(B, dtype=jnp.int32)[None, :]  # (1, B)
 
     has_nan = (nan_bin >= 0)[:, None]  # (F, 1)

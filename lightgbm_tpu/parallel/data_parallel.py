@@ -50,7 +50,8 @@ class DataParallelGrower:
         self.axis_name = axis_name
         self.spec = spec._replace(axis_name=axis_name)
 
-        row = P(axis_name)  # shard leading (row/block) axis
+        row = P(axis_name)  # shard the row axis of per-row vectors
+        bins_spec = P(None, axis_name)  # bins are (F, N): rows on axis 1
         rep = P()
 
         def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params, valid):
@@ -63,7 +64,7 @@ class DataParallelGrower:
             tree = jax.tree.map(lambda a: jax.lax.pmean(a, axis_name) if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
             return tree, row_leaf
 
-        in_specs = (row, rep, rep, rep, rep, row, row, row, rep, rep, row)
+        in_specs = (bins_spec, rep, rep, rep, rep, row, row, row, rep, rep, row)
         out_specs = (jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)), row)
         self._fn = jax.jit(
             jax.shard_map(
@@ -87,7 +88,7 @@ class DataParallelGrower:
         from ..learner.histogram import HIST_BLK
 
         n_dev = self.mesh.devices.size
-        n_rows = dev["bins"].shape[0]
+        n_rows = dev["bins"].shape[1]
         if (n_rows // n_dev) % HIST_BLK != 0:
             from .. import log
 
@@ -99,7 +100,9 @@ class DataParallelGrower:
         row = NamedSharding(self.mesh, P(self.axis_name))
         rep = NamedSharding(self.mesh, P())
         out = dict(dev)
-        out["bins"] = jax.device_put(dev["bins"], row)
+        out["bins"] = jax.device_put(
+            dev["bins"], NamedSharding(self.mesh, P(None, self.axis_name))
+        )
         out["valid"] = jax.device_put(dev["valid"], row)
         for k in ("nan_bin", "num_bins", "mono", "is_cat"):
             out[k] = jax.device_put(dev[k], rep)

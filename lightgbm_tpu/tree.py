@@ -235,7 +235,7 @@ class Tree:
         return imp
 
 
-def traverse_tree_bins(arrays: "TreeArrays", bins_rm, nan_bin):
+def traverse_tree_bins(arrays: "TreeArrays", bins_fm, nan_bin):
     """Device traversal of a grown tree over a BINNED matrix -> per-row leaf.
 
     Used to score validation sets each iteration (reference
@@ -245,13 +245,13 @@ def traverse_tree_bins(arrays: "TreeArrays", bins_rm, nan_bin):
     import jax.numpy as jnp
     from jax import lax
 
-    N, F = bins_rm.shape
+    F, N = bins_fm.shape
     n_nodes = arrays.num_nodes
 
     def body(k, row_node):
         # rows sitting at internal node k move to a child
         f = arrays.node_feature[k]
-        fbins = lax.dynamic_slice_in_dim(bins_rm, f, 1, axis=1).reshape(N)
+        fbins = lax.dynamic_slice_in_dim(bins_fm, f, 1, axis=0).reshape(N)
         fnan = nan_bin[f]
         go_left = jnp.where(
             arrays.node_cat[k],

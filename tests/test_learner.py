@@ -58,11 +58,11 @@ def test_best_split_matches_oracle():
     gh8 = build_gh8(
         jnp.asarray(grad), jnp.asarray(hess), jnp.ones(n, jnp.float32)
     )
-    bins_rm = jnp.asarray(bins.T.copy())
-    hist = histogram(bins_rm, gh8, B)
+    bins_rm = jnp.asarray(bins)
+    hist = histogram(bins_rm, gh8, B)  # (3, F, B)
     # each feature's histogram partitions all rows -> per-feature totals
     np.testing.assert_allclose(
-        np.asarray(hist[:, :, 0]).sum(axis=1), np.full(F, grad.sum()), rtol=1e-4
+        np.asarray(hist[0]).sum(axis=1), np.full(F, grad.sum()), rtol=1e-4
     )
     rec = best_split(
         hist,
@@ -81,7 +81,7 @@ def test_best_split_matches_oracle():
 
 def _grow(bins, grad, hess, spec):
     F, n = bins.shape
-    bins_rm = jnp.asarray(bins.T.copy())
+    bins_rm = jnp.asarray(bins)
     args = (
         bins_rm,
         jnp.full(F, -1, jnp.int32),
@@ -120,7 +120,7 @@ def test_data_parallel_matches_serial():
         pytest.skip("needs 8 devices")
     bins, grad, hess = _mk_problem(n=4096, F=6, B=32, seed=5)
     F, n = bins.shape
-    bins_rm = jnp.asarray(bins.T.copy())
+    bins_rm = jnp.asarray(bins)
     spec = GrowerSpec(num_leaves=15, num_bins=32, max_depth=-1)
     params = _params(min_data_in_leaf=5.0)
     common = (
@@ -150,3 +150,48 @@ def test_data_parallel_matches_serial():
         rtol=1e-3, atol=1e-5,
     )
     np.testing.assert_array_equal(np.asarray(rl_dp), np.asarray(rl_serial))
+
+
+def test_permuted_partition_matches_flat():
+    """The permuted-segment grower (production) and the flat row->leaf
+    grower (reference formulation) must produce identical trees and row
+    assignments."""
+    bins, grad, hess = _mk_problem(n=2048, F=5, B=32, seed=11)
+    spec_p = GrowerSpec(num_leaves=15, num_bins=32, max_depth=-1, partition="permuted")
+    spec_f = spec_p._replace(partition="flat")
+    tp, rlp = _grow(bins, grad, hess, spec_p)
+    tf, rlf = _grow(bins, grad, hess, spec_f)
+    assert int(tp.num_nodes) == int(tf.num_nodes)
+    np.testing.assert_array_equal(np.asarray(tp.node_feature), np.asarray(tf.node_feature))
+    np.testing.assert_array_equal(np.asarray(tp.node_bin), np.asarray(tf.node_bin))
+    np.testing.assert_array_equal(np.asarray(rlp), np.asarray(rlf))
+    np.testing.assert_allclose(
+        np.asarray(tp.leaf_value), np.asarray(tf.leaf_value), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_permuted_partition_with_bagging_and_padding():
+    """Out-of-bag rows follow the partition; padding rows stay leaf -1."""
+    bins, grad, hess = _mk_problem(n=1024, F=4, B=16, seed=13)
+    n = 1024
+    rs = np.random.RandomState(1)
+    bag = (rs.rand(n) < 0.7).astype(np.float32)
+    vld = np.ones(n, np.float32)
+    vld[-100:] = 0.0  # fake padding tail
+    bag = bag * vld
+    spec_p = GrowerSpec(num_leaves=7, num_bins=16, max_depth=-1, partition="permuted")
+    spec_f = spec_p._replace(partition="flat")
+    F = 4
+    args = lambda spec: grow_tree(
+        jnp.asarray(bins),
+        jnp.full(F, -1, jnp.int32), jnp.full(F, 16, jnp.int32),
+        jnp.zeros(F, jnp.int32), jnp.zeros(F, bool),
+        jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(bag),
+        jnp.ones(F, bool), _params(min_data_in_leaf=5.0), spec,
+        jnp.asarray(vld),
+    )
+    tp, rlp = args(spec_p)
+    tf, rlf = args(spec_f)
+    assert int(tp.num_nodes) == int(tf.num_nodes)
+    np.testing.assert_array_equal(np.asarray(rlp), np.asarray(rlf))
+    assert np.all(np.asarray(rlp)[-100:] == -1)
