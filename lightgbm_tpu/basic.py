@@ -464,6 +464,14 @@ class Booster:
         new_booster._gbdt = copy.copy(self._gbdt)
         new_booster._gbdt.models = [copy.deepcopy(t) for t in self._gbdt.models]
         new_booster._gbdt.device_trees = list(self._gbdt.device_trees)
+        # un-alias the remaining mutable members so future mutations on the
+        # refitted booster can never corrupt the source booster (the score
+        # arrays themselves are immutable jax arrays — the _ScoreSet
+        # containers and valids list are what must not be shared)
+        import dataclasses as _dc
+
+        new_booster._gbdt.train = _dc.replace(self._gbdt.train)
+        new_booster._gbdt.valids = [_dc.replace(v) for v in self._gbdt.valids]
         new_params = dict(self.config.explicit_params())
         new_params["refit_decay_rate"] = decay_rate
         new_booster.config = Config(new_params)

@@ -320,11 +320,10 @@ class GBDT:
             )
             ok = (arrays.num_nodes > 0).astype(jnp.float32)
             lv = arrays.leaf_value * (self.shrinkage_rate * ok)
-            if abs(init_scores[k]) > 1e-15:
-                # AddBias (gbdt.cpp:424-426): stored trees carry the
-                # boost-from-average bias; score got it at BoostFromAverage
-                lv = lv + init_scores[k] * ok
-            arrays = arrays._replace(leaf_value=lv)
+            # score updates use the UNBIASED shrunk leaf values — the
+            # score already received init_scores[k] at BoostFromAverage
+            # (mirrors _train_one_iter_sync; adding the bias here too
+            # would double-count it)
             self.train.score = self.train.score.at[k].set(
                 add_score(self.train.score[k], row_leaf, lv, one)
             )
@@ -334,6 +333,11 @@ class GBDT:
                 vs.score = vs.score.at[k].set(
                     add_score(vs.score[k], leaf, lv, one)
                 )
+            if abs(init_scores[k]) > 1e-15:
+                # AddBias (gbdt.cpp:424-426): only the STORED tree carries
+                # the boost-from-average bias
+                lv = lv + init_scores[k] * ok
+            arrays = arrays._replace(leaf_value=lv)
             self.device_trees.append((arrays, None))
             self._pending.append(arrays)
             self._pending_meta.append((k, init_scores[k], self.shrinkage_rate))

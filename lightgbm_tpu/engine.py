@@ -77,6 +77,7 @@ def train(
     cb_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     evaluation_result_list: List[Tuple] = []
+    i = -1
     for i in range(num_boost_round):
         for cb in cb_before:
             cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
@@ -99,6 +100,16 @@ def train(
 
     # flush the async training pipeline (fast-path pending device trees)
     booster._gbdt._materialize()
+    # the stop condition is only detected every _check_every iterations on
+    # the fast path; _materialize may have truncated blindly-trained
+    # iterations — clamp iteration-derived state to the surviving models
+    n_iters = booster._gbdt.num_trees() // booster._gbdt.num_class
+    if booster.best_iteration > n_iters:
+        booster.best_iteration = n_iters
+    if n_iters < i + 1:
+        # truncation rolled back the blindly-trained iterations whose
+        # scores produced the last eval — don't record stale values
+        evaluation_result_list = []
 
     # record best score
     for item in evaluation_result_list or []:

@@ -8,7 +8,7 @@ shapes:
 - the partition is a flat per-row leaf-id vector updated with masked
   `where` (reference CUDA data_index_to_leaf_index,
   cuda_data_partition.cu:113) — no index lists, no compaction;
-- per-leaf histograms live in a fixed (num_leaves, F, B, 3) tensor
+- per-leaf histograms live in a fixed (num_leaves, 3, F, B) tensor
   (the reference's HistogramPool, feature_histogram.hpp:1367, without
   eviction — recompute-free subtraction needs the parent kept);
 - each split computes the smaller child's histogram by masked scan and
@@ -88,7 +88,7 @@ class TreeArrays(NamedTuple):
 class _State(NamedTuple):
     i: jax.Array
     row_leaf: jax.Array
-    hist: jax.Array  # (L, F, B, 3)
+    hist: jax.Array  # (L, 3, F, B) — channel-leading, bins on lanes
     leaf_g: jax.Array
     leaf_h: jax.Array
     leaf_c: jax.Array

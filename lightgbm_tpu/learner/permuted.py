@@ -67,7 +67,7 @@ class _PState(NamedTuple):
     pperm: jax.Array  # (N,) int32 — original row index at each position
     seg_begin: jax.Array  # (L,) int32; unused leaves = N (sorts last)
     seg_count: jax.Array  # (L,) int32
-    hist: jax.Array  # (L, F, B, 3)
+    hist: jax.Array  # (L, 3, F, B) — channel-leading, bins on lanes
     leaf_g: jax.Array
     leaf_h: jax.Array
     leaf_c: jax.Array

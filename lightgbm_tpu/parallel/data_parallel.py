@@ -11,7 +11,7 @@ takes `axis_name="data"` and issues `lax.psum` on root sums and on each
 smaller-child histogram; everything downstream is computed redundantly
 (and identically) on every shard, so trees stay in lockstep without any
 split broadcast — the same invariant the reference relies on
-(SURVEY §3.3). The psum payload per split is one (F, B, 3) f32 histogram,
+(SURVEY §3.3). The psum payload per split is one (3, F, B) f32 histogram,
 matching the reference's wire payload of histogram pairs.
 """
 

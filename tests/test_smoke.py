@@ -110,3 +110,24 @@ def test_training_stops_when_unsplittable():
     assert bst.num_trees() <= 1
     pred = bst.predict(X)
     np.testing.assert_allclose(pred, np.mean(y), rtol=1e-5)
+
+
+def test_nonzero_mean_target_fast_path():
+    """Boost-from-average bias must not be double-counted on the async
+    fast path (score gets it once at BoostFromAverage; only the stored
+    tree carries it) — regression test for a mean-10 target."""
+    rs = np.random.RandomState(3)
+    X = rs.randn(1500, 8).astype(np.float32)
+    y = (10.0 + X[:, 0] * 0.5 + rs.randn(1500) * 0.1).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "learning_rate": 0.2,
+         "verbosity": -1},
+        ds, num_boost_round=30,
+    )
+    pred = bst.predict(X)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.5, f"RMSE {rmse} — boost-from-average bias double-counted?"
+    # internal training score must equal the stored-model prediction
+    internal = bst._gbdt.get_score(bst._gbdt.train)[0]
+    np.testing.assert_allclose(internal, bst.predict(X, raw_score=True), rtol=1e-4, atol=1e-4)
