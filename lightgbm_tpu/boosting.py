@@ -303,7 +303,7 @@ class GBDT:
             mask, gk, hk = self.strategy.sample(
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
-            feat_mask = self._sample_features()
+            feat_mask = self._sample_features(k=k)
             arrays, row_leaf = grow_tree(
                 self.dev["bins"],
                 self.dev["nan_bin"],
@@ -366,7 +366,7 @@ class GBDT:
             mask, gk, hk = self.strategy.sample(
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
-            feat_mask = self._sample_features()
+            feat_mask = self._sample_features(k=k)
             arrays, row_leaf = grow_tree(
                 self.dev["bins"],
                 self.dev["nan_bin"],
@@ -448,7 +448,242 @@ class GBDT:
         return False
 
     # ------------------------------------------------------------------
-    def _sample_features(self):
+    # Fused device loop ("fast path v2"): ONE jit dispatch per iteration
+    # covering gradients -> sampling -> growth -> score updates -> metric
+    # evaluation, with zero host readbacks. Trees and per-iteration metric
+    # vectors accumulate as device handles; the engine fetches a whole
+    # chunk in one device_get and replays callbacks host-side. This is
+    # the TPU reformulation of GBDT::Train (gbdt.cpp:245): the loop body
+    # is identical, only the host/device boundary moved from "every op"
+    # to "every chunk" because a single readback costs ~100ms on this
+    # runtime.
+    def fused_eligible(self) -> bool:
+        if self._force_sync or self.objective is None:
+            return False
+        if self.objective.is_renew_tree_output:
+            return False
+        if not getattr(self.objective, "is_device_gradients", True):
+            return False
+        from .device_metrics import supported_names
+
+        for ss in [self.train] + self.valids:
+            if supported_names(ss.metrics) is None:
+                return False
+        return True
+
+    def _build_fused(self, track_train: bool):
+        import jax
+        import jax.numpy as jnp
+
+        from .device_metrics import DeviceEvalSet
+
+        K = self.num_class
+        ds = self.train_set
+        c = self.config
+        eval_sets = []  # (ScoreSet index into [train]+valids, DeviceEvalSet)
+        sets = ([self.train] if track_train else []) + self.valids
+        for ss in sets:
+            from .device_metrics import supported_names
+
+            names, hb = supported_names(ss.metrics)
+            dev = ss.dataset.device_arrays()
+            meta = ss.dataset.metadata
+            label = jnp.asarray(ss.dataset.padded(meta.label))
+            weight = (
+                jnp.asarray(ss.dataset.padded(meta.weight))
+                if meta.weight is not None
+                else None
+            )
+            eval_sets.append(
+                (
+                    ss.name,
+                    DeviceEvalSet(c, names, hb, label, weight, dev["valid"], K),
+                )
+            )
+        self._f_eval_sets = eval_sets
+        n_valid_sets = len(self.valids)
+        vdevs = [vs.dataset.device_arrays() for vs in self.valids]
+        frac = c.feature_fraction
+        F = ds.num_used_features
+        n_feat = max(1, int(np.ceil(frac * F))) if frac < 1.0 else F
+        objective = self.objective
+        strategy = self.strategy
+        dev = self.dev
+        spec = self.spec
+        params = self.params
+        traverse = traverse_tree_bins
+        label_dev = self._label_dev
+        track_train_eval = track_train
+
+        def step(state):
+            score = state["score"]
+            vscores = state["vscores"]
+            it = state["it"]
+            shrink = state["shrink"]
+            init_vec = state["init"]
+            s_for_grad = score if K > 1 else score[0]
+            g, h = objective.get_gradients(s_for_grad)
+            grad = jnp.reshape(g, (K, -1)).astype(jnp.float32)
+            hess = jnp.reshape(h, (K, -1)).astype(jnp.float32)
+            trees = []
+            for k in range(K):
+                gk, hk = grad[k], hess[k]
+                mask, gk, hk = strategy.sample(it, gk, hk, dev["valid"], label_dev)
+                if frac < 1.0:
+                    fkey = jax.random.fold_in(
+                        jax.random.key(c.feature_fraction_seed), it * K + k
+                    )
+                    feat_mask = jax.random.permutation(fkey, F) < n_feat
+                else:
+                    feat_mask = jnp.ones(F, dtype=bool)
+                arrays, row_leaf = grow_tree(
+                    dev["bins"], dev["nan_bin"], dev["num_bins"], dev["mono"],
+                    dev["is_cat"], gk, hk, mask, feat_mask, params, spec,
+                    valid=dev["valid"],
+                )
+                ok = (arrays.num_nodes > 0).astype(jnp.float32)
+                lv = arrays.leaf_value * (shrink * ok)
+                one = jnp.float32(1.0)
+                score = score.at[k].set(add_score(score[k], row_leaf, lv, one))
+                new_vs = []
+                for vi in range(n_valid_sets):
+                    vleaf = traverse(arrays, vdevs[vi]["bins"], vdevs[vi]["nan_bin"])
+                    new_vs.append(
+                        vscores[vi].at[k].set(
+                            add_score(vscores[vi][k], vleaf, lv, one)
+                        )
+                    )
+                vscores = tuple(new_vs)
+                # stored tree carries the boost-from-average bias on the
+                # first iteration only (AddBias, gbdt.cpp:424); the score
+                # got it at fused_start
+                lv_stored = lv + init_vec[k] * ok * (it == 0)
+                trees.append(arrays._replace(leaf_value=lv_stored))
+            # metric evaluation entirely on device
+            eval_scores = ([score] if track_train_eval else []) + list(vscores)
+            rows = [f(s) for (_, f), s in zip(eval_sets, eval_scores)]
+            eval_row = (
+                jnp.concatenate(rows) if rows else jnp.zeros(0, jnp.float32)
+            )
+            new_state = {
+                "score": score,
+                "vscores": vscores,
+                "it": it + 1,
+                "shrink": shrink,
+                "init": init_vec,
+            }
+            return new_state, tuple(trees), eval_row
+
+        self._f_step = jax.jit(step, donate_argnums=(0,))
+
+    def fused_start(self, track_train: bool) -> None:
+        """Initialize the device loop state; performs BoostFromAverage."""
+        import jax.numpy as jnp
+
+        K = self.num_class
+        init_scores = [0.0] * K
+        if (
+            not self._models
+            and not self._pending
+            and self.config.boost_from_average
+            and not self.has_init_score
+        ):
+            for k in range(K):
+                init = self.objective.boost_from_score(k)
+                if abs(init) > 1e-15:
+                    init_scores[k] = init
+                    self.train.score = self.train.score.at[k].add(init)
+                    for vs in self.valids:
+                        vs.score = vs.score.at[k].add(init)
+                    log.info(f"Start training from score {init:f}")
+        self._init_scores = init_scores
+        self._build_fused(track_train)
+        self._fstate = {
+            "score": self.train.score,
+            "vscores": tuple(vs.score for vs in self.valids),
+            "it": jnp.int32(self.iter_),
+            "shrink": jnp.float32(self.shrinkage_rate),
+            "init": jnp.asarray(np.asarray(init_scores, np.float32)),
+        }
+        self._f_evals: List[Any] = []
+
+    def fused_dispatch(self, n: int) -> None:
+        """Dispatch n fused iterations without any host synchronization."""
+        for _ in range(n):
+            self._fstate, trees, eval_row = self._f_step(self._fstate)
+            for k, arrays in enumerate(trees):
+                self.device_trees.append((arrays, None))
+                self._pending.append(arrays)
+                self._pending_meta.append(
+                    (k, self._init_scores[k] if self.iter_ == 0 else 0.0,
+                     self.shrinkage_rate)
+                )
+            self._f_evals.append(eval_row)
+            self.iter_ += 1
+        # keep canonical score handles current (no sync; handle reassign)
+        self.train.score = self._fstate["score"]
+        for vs, s in zip(self.valids, self._fstate["vscores"]):
+            vs.score = s
+
+    def fused_collect(self) -> List[List[Tuple[str, str, float, bool]]]:
+        """One chunk boundary: fetch eval rows + materialize trees.
+        Returns per-iteration evaluation tuple lists (possibly truncated
+        when the no-splittable-leaf stop condition fired mid-chunk)."""
+        import jax
+        import jax.numpy as jnp
+
+        n_iter_before = len(self._models) // self.num_class
+        evals = self._f_evals
+        self._f_evals = []
+        if evals:
+            mat = np.asarray(jax.device_get(jnp.stack(evals)))
+        else:
+            mat = np.zeros((0, 0), np.float32)
+        self._materialize()
+        n_iter_after = len(self._models) // self.num_class
+        produced = n_iter_after - n_iter_before
+        records: List[List[Tuple[str, str, float, bool]]] = []
+        for r in range(min(produced, mat.shape[0])):
+            row = mat[r]
+            out: List[Tuple[str, str, float, bool]] = []
+            j = 0
+            for name, des in self._f_eval_sets:
+                for mname, hb in zip(des.names, des.higher_better):
+                    out.append((name, mname, float(row[j]), hb))
+                    j += 1
+            records.append(out)
+        return records
+
+    def fused_truncate(self, n_iters: int) -> None:
+        """Drop models beyond n_iters iterations (early stop fired before
+        the chunk boundary; matches reference stop-at-callback timing).
+        Rolls the dropped trees' contributions back out of the train and
+        valid scores so booster state stays consistent with the stored
+        model (same contract as rollback_one_iter)."""
+        K = self.num_class
+        self._materialize()
+        for mi in range(n_iters * K, len(self.device_trees)):
+            arrays, _ = self.device_trees[mi]
+            k = mi % K
+            if self._models[mi].num_leaves > 1:
+                leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"])
+                self.train.score = self.train.score.at[k].add(
+                    -arrays.leaf_value[leaf]
+                )
+                for vs in self.valids:
+                    vdev = vs.dataset.device_arrays()
+                    vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                    vs.score = vs.score.at[k].add(-arrays.leaf_value[vleaf])
+        del self._models[n_iters * K:]
+        del self.device_trees[n_iters * K:]
+        self.iter_ = min(self.iter_, n_iters)
+
+    # ------------------------------------------------------------------
+    def _sample_features(self, it=None, k: int = 0):
+        """Per-tree feature_fraction mask (ColSampler, col_sampler.hpp:20).
+        Keyed on (feature_fraction_seed, iter*K + k) so the sync and fused
+        paths draw identical masks for the same iteration."""
+        import jax
         import jax.numpy as jnp
 
         F = self.train_set.num_used_features
@@ -456,10 +691,13 @@ class GBDT:
         if frac >= 1.0:
             return jnp.ones(F, dtype=bool)
         n = max(1, int(np.ceil(frac * F)))
-        chosen = self._feat_rng.choice(F, n, replace=False)
-        m = np.zeros(F, dtype=bool)
-        m[chosen] = True
-        return jnp.asarray(m)
+        if it is None:
+            it = self.iter_
+        fkey = jax.random.fold_in(
+            jax.random.key(self.config.feature_fraction_seed),
+            it * self.num_class + k,
+        )
+        return jax.random.permutation(fkey, F) < n
 
     def _renew_tree_output(
         self, arrays: TreeArrays, row_leaf, k: int, mask, resid=None
@@ -901,7 +1139,7 @@ class RF(GBDT):
             mask, gk, hk = self.strategy.sample(
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
-            feat_mask = self._sample_features()
+            feat_mask = self._sample_features(k=k)
             arrays, row_leaf = grow_tree(
                 self.dev["bins"], self.dev["nan_bin"], self.dev["num_bins"],
                 self.dev["mono"], self.dev["is_cat"], gk, hk, mask, feat_mask,

@@ -78,25 +78,73 @@ def train(
 
     evaluation_result_list: List[Tuple] = []
     i = -1
-    for i in range(num_boost_round):
-        for cb in cb_before:
-            cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
-        finished = booster.update(fobj=fobj)
+    use_fused = (
+        fobj is None
+        and feval is None
+        and not cb_before
+        and hasattr(booster._gbdt, "fused_eligible")
+        and booster._gbdt.fused_eligible()
+    )
+    if use_fused:
+        # fused device loop: one jit dispatch per iteration, zero host
+        # syncs; evals fetched per chunk and callbacks replayed in order
+        # (identical per-iteration semantics, delivered late)
+        gbdt = booster._gbdt
+        gbdt.train.name = booster._train_data_name
+        gbdt.fused_start(track_train=valid_contain_train)
+        chunk = gbdt._check_every
+        done = 0
+        stop = False
+        while done < num_boost_round and not stop:
+            n = min(chunk, num_boost_round - done)
+            gbdt.fused_dispatch(n)
+            records = gbdt.fused_collect()
+            for j, evals in enumerate(records):
+                i = done + j
+                evaluation_result_list = evals
+                try:
+                    for cb in cb_after:
+                        cb(CallbackEnv(booster, params, i, 0, num_boost_round, evals))
+                except EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    evaluation_result_list = e.best_score
+                    gbdt.fused_truncate(i + 1)
+                    stop = True
+                    break
+            done += max(len(records), 1)
+            if gbdt._stopped:
+                # the sync path runs cb_after once for the stop iteration
+                # (whose eval equals the previous iteration's: the failed
+                # trees were rolled back) — replay that here too
+                if not stop and done < num_boost_round:
+                    try:
+                        for cb in cb_after:
+                            cb(CallbackEnv(booster, params, done, 0,
+                                           num_boost_round, evaluation_result_list))
+                    except EarlyStopException as e:
+                        booster.best_iteration = e.best_iteration + 1
+                        evaluation_result_list = e.best_score
+                break
+    else:
+        for i in range(num_boost_round):
+            for cb in cb_before:
+                cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
+            finished = booster.update(fobj=fobj)
 
-        evaluation_result_list = []
-        if valid_contain_train:
-            evaluation_result_list.extend(booster.eval_train(feval))
-        if booster._gbdt.valids:
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in cb_after:
-                cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
-        except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            evaluation_result_list = e.best_score
-            break
-        if finished:
-            break
+            evaluation_result_list = []
+            if valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            if booster._gbdt.valids:
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in cb_after:
+                    cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                evaluation_result_list = e.best_score
+                break
+            if finished:
+                break
 
     # flush the async training pipeline (fast-path pending device trees)
     booster._gbdt._materialize()

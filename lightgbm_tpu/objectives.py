@@ -34,6 +34,9 @@ class ObjectiveFunction:
     # objectives that refit leaf outputs with residual percentiles
     # (objective_function.h:55 IsRenewTreeOutput)
     is_renew_tree_output = False
+    # get_gradients is pure jax (traceable into the fused device loop);
+    # host-loop objectives (lambdarank) override to False
+    is_device_gradients = True
 
     def __init__(self, config: Config):
         self.config = config
@@ -387,6 +390,7 @@ class LambdaRank(ObjectiveFunction):
 
     name = "lambdarank"
     is_ranking = True
+    is_device_gradients = False
 
     def init(self, dataset):
         super().init(dataset)

@@ -54,12 +54,16 @@ class BaggingStrategy(SampleStrategy):
         )
 
     def sample(self, iter_num, grad, hess, valid, label):
+        """iter_num may be a host int or a traced int32 (fused loop): the
+        mask is a pure function of the bagging window, so the reference's
+        cached-mask-per-freq-window behavior falls out of keying the RNG
+        on (iter // bagging_freq) with no host state."""
         c = self.config
         if not self.enabled:
             return valid, grad, hess
-        if self._cached_mask is not None and iter_num % c.bagging_freq != 0:
-            return self._cached_mask, grad, hess
-        key = jax.random.key(c.bagging_seed + iter_num)
+        it = jnp.asarray(iter_num, jnp.int32)
+        window = (it // c.bagging_freq) * c.bagging_freq
+        key = jax.random.fold_in(jax.random.key(c.bagging_seed), window)
         u = jax.random.uniform(key, valid.shape)
         if self.use_pos_neg and label is not None:
             frac = jnp.where(
@@ -68,7 +72,6 @@ class BaggingStrategy(SampleStrategy):
         else:
             frac = c.bagging_fraction
         mask = (u < frac).astype(jnp.float32) * valid
-        self._cached_mask = mask
         return mask, grad, hess
 
 
@@ -85,24 +88,35 @@ class GOSSStrategy(SampleStrategy):
     def sample(self, iter_num, grad, hess, valid, label):
         c = self.config
         warmup = int(1.0 / c.learning_rate) + 1
-        if iter_num < warmup:
+        it = jnp.asarray(iter_num, jnp.int32)
+
+        def _goss(_):
+            w = jnp.abs(grad * hess) * valid
+            n_valid = jnp.sum(valid)
+            top_n = jnp.maximum((n_valid * c.top_rate).astype(jnp.int32), 1)
+            # threshold = top_n-th largest weight
+            sorted_w = jnp.sort(w)[::-1]
+            thr = sorted_w[jnp.minimum(top_n, w.shape[0] - 1)]
+            top_mask = w > thr
+            rest = (~top_mask) & (valid > 0)
+            key = jax.random.fold_in(jax.random.key(c.bagging_seed * 7919), it)
+            p_rest = c.other_rate / max(1e-12, 1.0 - c.top_rate)
+            rand_mask = jax.random.uniform(key, w.shape) < p_rest
+            sampled = rest & rand_mask
+            amp = (1.0 - c.top_rate) / max(c.other_rate, 1e-12)
+            mult = top_mask.astype(jnp.float32) + sampled.astype(jnp.float32) * amp
+            mask = (top_mask | sampled).astype(jnp.float32) * valid
+            return mask, grad * mult, hess * mult
+
+        def _no_sample(_):
             return valid, grad, hess
-        w = jnp.abs(grad * hess) * valid
-        n_valid = jnp.sum(valid)
-        top_n = jnp.maximum((n_valid * c.top_rate).astype(jnp.int32), 1)
-        # threshold = top_n-th largest weight
-        sorted_w = jnp.sort(w)[::-1]
-        thr = sorted_w[jnp.minimum(top_n, w.shape[0] - 1)]
-        top_mask = w > thr
-        rest = (~top_mask) & (valid > 0)
-        key = jax.random.key(c.bagging_seed * 7919 + iter_num)
-        p_rest = c.other_rate / max(1e-12, 1.0 - c.top_rate)
-        rand_mask = jax.random.uniform(key, w.shape) < p_rest
-        sampled = rest & rand_mask
-        amp = (1.0 - c.top_rate) / max(c.other_rate, 1e-12)
-        mult = top_mask.astype(jnp.float32) + sampled.astype(jnp.float32) * amp
-        mask = (top_mask | sampled).astype(jnp.float32) * valid
-        return mask, grad * mult, hess * mult
+
+        if isinstance(iter_num, (int, np.integer)):
+            # host path: avoid tracing/compiling the unused branch
+            return _goss(None) if iter_num >= warmup else _no_sample(None)
+        from jax import lax
+
+        return lax.cond(it >= warmup, _goss, _no_sample, None)
 
 
 def create_sample_strategy(config: Config, num_data: int) -> SampleStrategy:

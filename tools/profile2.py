@@ -1,0 +1,74 @@
+"""Careful in-jit loop timings to separate dispatch from device cost."""
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+N, F, B, L = 1_048_576, 28, 256, 255
+from lightgbm_tpu.learner.histogram import HIST_BLK, build_gh8, histogram
+from lightgbm_tpu.learner.split import best_split
+from lightgbm_tpu.learner import make_split_params
+from lightgbm_tpu.config import Config
+
+rs = np.random.RandomState(0)
+bins = jnp.asarray(rs.randint(0, B-1, size=(F, N)).astype(np.int32))
+gh8 = jnp.asarray(rs.randn(8, N).astype(np.float32))
+nan_bin = jnp.full(F, -1, jnp.int32); num_bins = jnp.full(F, B, jnp.int32)
+mono = jnp.zeros(F, jnp.int32); is_cat = jnp.zeros(F, bool); fm = jnp.ones(F, bool)
+params = make_split_params(Config({"num_leaves": L}))
+
+def bench(name, jitted, *args, iters=1):
+    r = jitted(*args); jax.block_until_ready(r)
+    t0 = time.time(); r = jitted(*args); jax.block_until_ready(r)
+    dt = time.time() - t0
+    print(f"{name}: {dt/iters*1000:.3f} ms/iter  (total {dt*1000:.1f} ms / {iters})")
+
+# pallas hist, 20 carry-dependent calls in one jit
+@jax.jit
+def hist20(b, g):
+    def body(i, acc):
+        h = histogram(b, g + acc[0,0,0]*0 + i*0.0, B)  # carry dep to defeat CSE
+        return acc + h
+    return lax.fori_loop(0, 20, body, jnp.zeros((3, F, B), jnp.float32))
+bench("pallas hist full-N x20 in-jit", hist20, bins, gh8, iters=20)
+
+# best_split, 100 carry-dependent calls
+@jax.jit
+def bs100(h):
+    def body(i, acc):
+        r = best_split(h + acc*0, jnp.float32(0.), jnp.float32(N), jnp.float32(N),
+                       num_bins, nan_bin, mono, is_cat, params, fm)
+        return acc + r.gain
+    return lax.fori_loop(0, 100, body, jnp.float32(0.))
+h0 = histogram(bins, gh8, B); jax.block_until_ready(h0)
+bench("best_split x100 in-jit", bs100, h0, iters=100)
+
+# gather along axis0 of (N, F) vs axis1 of (F, N)
+bins_nm = jnp.asarray(np.ascontiguousarray(np.asarray(bins).T))  # (N, F)
+perm = jnp.asarray(rs.permutation(N).astype(np.int32))
+g0 = jax.jit(lambda b, p: jnp.take(b, p, axis=0))
+bench("gather (N,F) axis0", g0, bins_nm, perm)
+# 1-D gather
+col = bins[0]
+g1 = jax.jit(lambda c, p: jnp.take(c, p))
+bench("gather 1-D (N,)", g1, col, perm)
+# scatter 1-D
+s1 = jax.jit(lambda c, p: jnp.zeros_like(c).at[p].set(c))
+bench("scatter 1-D (N,)", s1, col, perm)
+# cumsum full-N
+cs = jax.jit(lambda m: jnp.cumsum(m))
+bench("cumsum (N,) int32", cs, col)
+# sort full-N with 1 payload
+srt = jax.jit(lambda k, v: lax.sort((k, v), num_keys=1))
+bench("sort (N,) key + 1 payload", srt, col, perm)
+
+# empty-ish while_loop fixed overhead: 254 iterations of trivial bookkeeping
+@jax.jit
+def wl(x):
+    def cond(s): return s[0] < 254
+    def body(s):
+        i, a = s
+        return (i+1, a.at[i].set(a[i] + 1.0))
+    return lax.while_loop(cond, body, (jnp.int32(0), x))
+bench("while_loop 254 trivial iters", wl, jnp.zeros(L, jnp.float32), iters=254)

@@ -1,0 +1,80 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+N, F, B = 1_048_576, 28, 256
+from lightgbm_tpu.learner.histogram import build_gh8
+from lightgbm_tpu.learner.pallas_hist import hist_tpu
+
+rs = np.random.RandomState(0)
+bins = jnp.asarray(rs.randint(0, B-1, size=(F, N)).astype(np.int32))
+gh8 = jnp.asarray(rs.randn(8, N).astype(np.float32))
+
+def bench(name, jitted, *args, iters=1):
+    r = jitted(*args); jax.block_until_ready(r)
+    t0 = time.time(); r = jitted(*args); jax.block_until_ready(r)
+    dt = time.time() - t0
+    print(f"{name}: {dt/iters*1000:.3f} ms/iter (total {dt*1000:.1f})")
+
+# real pallas hist cost: carry-dependence that can't be simplified away
+@jax.jit
+def hist5(b, g):
+    def body(i, acc):
+        h = hist_tpu(b, g * (1.0 + acc[0, 0] * 1e-30), B)
+        return acc + h[:, 0, :1]
+    return lax.fori_loop(0, 5, body, jnp.zeros((8, 1), jnp.float32))
+bench("pallas hist full-N (real, x5)", hist5, bins, gh8, iters=5)
+
+# single call
+one = jax.jit(lambda b, g: hist_tpu(b, g, B))
+bench("pallas hist full-N single", one, bins, gh8)
+
+# loop floor: trivial arithmetic body
+@jax.jit
+def loop_arith(x):
+    def body(i, a): return a * 1.0000001 + 1.0
+    return lax.fori_loop(0, 1000, body, x)
+bench("fori_loop 1000 trivial-arith iters", loop_arith, jnp.float32(0.0), iters=1000)
+
+# loop floor: small-array dynamic update body
+@jax.jit
+def loop_upd(x):
+    def body(i, a): return a.at[i % 255].set(a[i % 255] + 1.0)
+    return lax.fori_loop(0, 1000, body, x)
+bench("fori_loop 1000 small-dynupd iters", loop_upd, jnp.zeros(255, jnp.float32), iters=1000)
+
+# loop floor with a medium body (~30 small ops)
+@jax.jit
+def loop_med(x):
+    def body(i, a):
+        for _ in range(10):
+            a = a * 1.0000001
+            a = a.at[i % 255].set(a[(i+1) % 255] + 1.0)
+            a = jnp.roll(a, 1)
+        return a
+    return lax.fori_loop(0, 200, body, x)
+bench("fori_loop 200 medium-body iters", loop_med, jnp.zeros(255, jnp.float32), iters=200)
+
+# dispatch latency: tiny jit called 100x from host
+tiny = jax.jit(lambda x: x + 1.0)
+x = jnp.float32(0.0); tiny(x)
+jax.block_until_ready(tiny(x))
+t0 = time.time()
+for _ in range(100):
+    x = tiny(x)
+jax.block_until_ready(x)
+print(f"host-dispatch tiny jit: {(time.time()-t0)/100*1000:.3f} ms/call")
+
+# device_get latency of a tiny array
+y = jnp.zeros(16, jnp.float32)
+jax.block_until_ready(y)
+t0 = time.time()
+for _ in range(20):
+    _ = jax.device_get(y)
+print(f"device_get tiny: {(time.time()-t0)/20*1000:.3f} ms/call")
+
+# elementwise full-N pass (bandwidth check)
+ew = jax.jit(lambda g: g * 1.5 + 1.0)
+bench("elementwise (8,N) f32", ew, gh8)

@@ -1,0 +1,96 @@
+"""Ground-truth device timing: run K chained iterations + one device_get;
+slope over K = true per-iteration device cost (readback constant cancels)."""
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+N, F, B, L = 1_048_576, 28, 256, 255
+from lightgbm_tpu.learner.histogram import build_gh8
+from lightgbm_tpu.learner.pallas_hist import hist_tpu
+from lightgbm_tpu.learner.split import best_split
+from lightgbm_tpu.learner import make_split_params
+from lightgbm_tpu.config import Config
+
+rs = np.random.RandomState(0)
+bins = jnp.asarray(rs.randint(0, B-1, size=(F, N)).astype(np.int32))
+gh8 = jnp.asarray(rs.randn(8, N).astype(np.float32))
+nan_bin = jnp.full(F, -1, jnp.int32); num_bins = jnp.full(F, B, jnp.int32)
+mono = jnp.zeros(F, jnp.int32); is_cat = jnp.zeros(F, bool); fm = jnp.ones(F, bool)
+params = make_split_params(Config({"num_leaves": L}))
+
+def slope(name, make_fn, k_small=1, k_big=11):
+    f_s, f_b = make_fn(k_small), make_fn(k_big)
+    for f in (f_s, f_b):
+        _ = jax.device_get(f())  # compile + warm
+    ts = []
+    for f, k in ((f_s, k_small), (f_b, k_big), (f_s, k_small), (f_b, k_big)):
+        t0 = time.time(); _ = jax.device_get(f()); ts.append(time.time() - t0)
+    per = ((ts[1] + ts[3]) - (ts[0] + ts[2])) / (2 * (k_big - k_small))
+    base = (ts[0] + ts[2]) / 2
+    print(f"{name}: {per*1000:.3f} ms/iter (1-iter wall {base*1000:.0f} ms)")
+
+# pallas hist full-N
+def mk_hist(k):
+    @jax.jit
+    def f():
+        def body(i, acc):
+            h = hist_tpu(bins, gh8 * (1.0 + acc[0, 0] * 1e-30), B)
+            return acc + h[:, 0, :1]
+        return lax.fori_loop(0, k, body, jnp.zeros((8, 1), jnp.float32))
+    return f
+slope("pallas hist full-N", mk_hist)
+
+# elementwise (8,N)
+def mk_ew(k):
+    @jax.jit
+    def f():
+        def body(i, a): return a * 1.0000001 + 1.0
+        return lax.fori_loop(0, k, body, gh8)[0, :4]
+    return f
+slope("elementwise (8,N)", mk_ew)
+
+# best_split
+h0 = jax.device_get(jax.jit(lambda: hist_tpu(bins, gh8, B))())
+h0j = jnp.asarray(h0[:3].reshape(3, F, B))
+def mk_bs(k):
+    @jax.jit
+    def f():
+        def body(i, acc):
+            r = best_split(h0j * (1.0 + acc * 1e-30), jnp.float32(0.), jnp.float32(N), jnp.float32(N),
+                           num_bins, nan_bin, mono, is_cat, params, fm)
+            return acc + r.gain
+        return lax.fori_loop(0, k, body, jnp.float32(0.))
+    return f
+slope("best_split", mk_bs, 1, 21)
+
+# loop floor trivial
+def mk_triv(k):
+    @jax.jit
+    def f():
+        def body(i, a): return a * 1.0000001 + 1.0
+        return lax.fori_loop(0, k, body, jnp.float32(0.0))
+    return f
+slope("loop floor (scalar arith)", mk_triv, 10, 1010)
+
+# gather full-N lane axis
+perm = jnp.asarray(rs.permutation(N).astype(np.int32))
+def mk_gat(k):
+    @jax.jit
+    def f():
+        def body(i, p): return jnp.take(p, perm)
+        return lax.fori_loop(0, k, body, perm)[:4]
+    return f
+slope("gather 1-D (N,)", mk_gat, 1, 5)
+
+# dynamic_update_slice (8, N) at dynamic offset (partition write pattern)
+def mk_dus(k):
+    @jax.jit
+    def f():
+        def body(i, a):
+            chunk = lax.dynamic_slice(a, (0, i * 128), (8, 65536)) * 1.0000001
+            return lax.dynamic_update_slice(a, chunk, (0, i * 128))
+        return lax.fori_loop(0, k, body, gh8)[0, :4]
+    return f
+slope("dynslice+update (8,64K)", mk_dus, 1, 11)

@@ -1,0 +1,23 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+
+xs = [jnp.zeros(16, jnp.float32) + i for i in range(100)]
+jax.block_until_ready(xs)
+big = jnp.zeros((100, 16), jnp.float32)
+one = jnp.zeros(16, jnp.float32)
+jax.block_until_ready([big, one])
+for name, obj in [("1 tiny", one), ("list of 100 tiny", xs), ("1 packed (100,16)", big)]:
+    t0 = time.time(); _ = jax.device_get(obj); t1 = time.time()
+    t2 = time.time(); _ = jax.device_get(obj); t3 = time.time()
+    print(f"device_get {name}: {min(t1-t0, t3-t2)*1000:.1f} ms")
+
+# med array
+m = jnp.zeros((255, 15), jnp.float32); jax.block_until_ready(m)
+t0=time.time(); _ = jax.device_get(m); print(f"device_get (255,15): {(time.time()-t0)*1000:.1f} ms")
+
+# host->device transfer latency
+h = np.zeros(16, np.float32)
+t0 = time.time()
+for _ in range(10): d = jnp.asarray(h); jax.block_until_ready(d)
+print(f"h2d tiny x10: {(time.time()-t0)*100:.1f} ms each")

@@ -1,0 +1,80 @@
+"""Per-component timing of the tree grower on the real device."""
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+N = int(os.environ.get("N", 1_000_000))
+F = int(os.environ.get("F", 28))
+B = int(os.environ.get("B", 256))
+L = int(os.environ.get("L", 255))
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.learner import GrowerSpec, grow_tree, make_split_params
+from lightgbm_tpu.learner.histogram import HIST_BLK, build_gh8, histogram
+
+rs = np.random.RandomState(0)
+Npad = ((N + HIST_BLK - 1) // HIST_BLK) * HIST_BLK
+bins = rs.randint(0, B - 1, size=(F, Npad)).astype(np.int32)
+grad = rs.randn(Npad).astype(np.float32)
+hess = np.ones(Npad, np.float32)
+mask = np.ones(Npad, np.float32); mask[N:] = 0
+
+bins_d = jnp.asarray(bins)
+grad_d = jnp.asarray(grad); hess_d = jnp.asarray(hess); mask_d = jnp.asarray(mask)
+nan_bin = jnp.full(F, -1, jnp.int32)
+num_bins = jnp.full(F, B, jnp.int32)
+mono = jnp.zeros(F, jnp.int32)
+is_cat = jnp.zeros(F, bool)
+feat_mask = jnp.ones(F, bool)
+cfg = Config({"num_leaves": L, "min_data_in_leaf": 20})
+params = make_split_params(cfg)
+
+def timeit(name, fn, n=3):
+    fn()  # compile
+    jax.block_until_ready(fn())
+    t0 = time.time()
+    for _ in range(n):
+        r = fn()
+    jax.block_until_ready(r)
+    dt = (time.time() - t0) / n
+    print(f"{name}: {dt*1000:.2f} ms")
+    return dt
+
+# 1. full-N histogram (pallas kernel)
+gh8 = build_gh8(grad_d * mask_d, hess_d * mask_d, mask_d)
+gh8 = jax.block_until_ready(gh8)
+hist_j = jax.jit(lambda b, g: histogram(b, g, B))
+timeit("hist full-N (pallas)", lambda: hist_j(bins_d, gh8))
+
+# 2. best_split alone
+from lightgbm_tpu.learner.split import best_split
+h0 = hist_j(bins_d, gh8)
+bs_j = jax.jit(lambda h: best_split(h, jnp.float32(0.), jnp.float32(Npad), jnp.float32(Npad),
+                                    num_bins, nan_bin, mono, is_cat, params, feat_mask))
+timeit("best_split", lambda: bs_j(h0))
+
+# 3. the partition-style gather: take along lane axis at full N
+perm = jnp.asarray(rs.permutation(Npad).astype(np.int32))
+gat_j = jax.jit(lambda b, p: jnp.take(b, p, axis=1))
+timeit("gather (F,N) lane axis", lambda: gat_j(bins_d, perm))
+gat8_j = jax.jit(lambda g, p: jnp.take(g, p, axis=1))
+timeit("gather (8,N) lane axis", lambda: gat8_j(gh8, perm))
+
+# 4. nonzero compaction at full N
+nz_j = jax.jit(lambda m: jnp.nonzero(m > 0.5, size=Npad, fill_value=Npad)[0])
+timeit("nonzero full-N", lambda: nz_j(mask_d))
+
+# 5. full tree: permuted vs flat
+for part in ["permuted", "flat"]:
+    spec = GrowerSpec(num_leaves=L, num_bins=B, max_depth=-1, axis_name=None, partition=part)
+    def run():
+        t, rl = grow_tree(bins_d, nan_bin, num_bins, mono, is_cat,
+                          grad_d, hess_d, mask_d, feat_mask, params, spec, valid=mask_d)
+        return rl
+    print(f"-- compiling {part} ...")
+    t0 = time.time()
+    jax.block_until_ready(run())
+    print(f"   compile+first: {time.time()-t0:.1f} s")
+    timeit(f"grow_tree[{part}] {L} leaves", run, n=2)
