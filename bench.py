@@ -8,27 +8,94 @@ Baseline: the reference's published Higgs result — 500 iterations of
 linearly to this bench's row count (histogram GBDT cost is ~linear in
 rows), i.e. baseline trees/sec at R rows = (500 / 130.094) * (10.5e6 / R).
 
+Robustness (the round-2 bench died on a TPU-backend init hang and left
+no evidence): the accelerator backend is probed in a SUBPROCESS with a
+hard timeout before jax is imported here; on probe failure the bench
+falls back to JAX_PLATFORMS=cpu instead of hanging. Progress lines go
+to stderr per iteration chunk, and partial results are persisted to
+bench_partial.json as training advances, so even a killed run yields
+data. The final stdout line is always the single JSON line.
+
+The timed loop trains WITH per-iteration validation metrics enabled
+(device-resident eval on a held-out set) — deliberately a heavier
+workload than the baseline's bare training time, because sustained
+trees/sec with live eval is the number that matters for users.
+
 Env overrides: BENCH_ROWS, BENCH_FEATURES, BENCH_LEAVES, BENCH_TREES,
-BENCH_WARMUP, BENCH_MAX_BIN.
+BENCH_WARMUP, BENCH_MAX_BIN, BENCH_PROBE_TIMEOUT (s), BENCH_FORCE_CPU.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+_PROBE_SRC = r"""
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print(jax.devices()[0].platform)
+"""
+
+
+def probe_backend(timeout_s: float) -> str:
+    """Run a tiny jit in a subprocess; return its platform or 'cpu'."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+        sys.stderr.write(
+            f"[bench] backend probe rc={r.returncode}: "
+            f"{r.stderr.strip()[-500:]}\n"
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"[bench] backend probe timed out ({timeout_s}s)\n")
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"[bench] backend probe failed: {e}\n")
+    return "cpu"
 
 
 def main() -> None:
     rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     feats = int(os.environ.get("BENCH_FEATURES", 28))
     leaves = int(os.environ.get("BENCH_LEAVES", 255))
-    trees = int(os.environ.get("BENCH_TREES", 10))
+    trees = int(os.environ.get("BENCH_TREES", 100))
     warmup = int(os.environ.get("BENCH_WARMUP", 2))
     max_bin = int(os.environ.get("BENCH_MAX_BIN", 255))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))
+    partial_path = os.path.join(REPO, "bench_partial.json")
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("BENCH_FORCE_CPU"):
+        platform = "cpu"
+    elif os.environ.get("JAX_PLATFORMS") == "cpu":
+        platform = "cpu"
+    else:
+        # probe even when JAX_PLATFORMS=axon (the default env): the probe
+        # exists precisely to detect a dead TPU tunnel before hanging
+        t0 = time.time()
+        platform = probe_backend(probe_timeout)
+        sys.stderr.write(
+            f"[bench] backend probe -> {platform} in {time.time()-t0:.0f}s\n"
+        )
+    if platform == "cpu":
+        # sitecustomize may have imported jax already — the env var alone
+        # is read too early, set the config explicitly as well
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, REPO)
     import lightgbm_tpu as lgb
 
     rs = np.random.RandomState(17)
@@ -36,6 +103,11 @@ def main() -> None:
     w = rs.randn(feats)
     logits = X[:, : feats // 2] @ w[: feats // 2] + np.sin(X[:, feats // 2]) * 2.0
     y = (logits + rs.randn(rows) > 0).astype(np.float32)
+    # held-out validation rows (NOT part of the training matrix)
+    nv = min(rows // 10, 100_000)
+    Xv = rs.randn(nv, feats).astype(np.float32)
+    lv = Xv[:, : feats // 2] @ w[: feats // 2] + np.sin(Xv[:, feats // 2]) * 2.0
+    yv = (lv + rs.randn(nv) > 0).astype(np.float32)
 
     params = {
         "objective": "binary",
@@ -46,13 +118,43 @@ def main() -> None:
         "metric": "auc",
         "verbosity": -1,
     }
+    t0 = time.time()
     ds = lgb.Dataset(X, label=y, free_raw_data=False)
     ds.construct()
+    vs = lgb.Dataset(Xv, label=yv, reference=ds, free_raw_data=False)
+    sys.stderr.write(f"[bench] dataset built in {time.time()-t0:.1f}s\n")
 
-    # warmup: compile + first trees
-    bst = lgb.train(dict(params), ds, num_boost_round=warmup)
+    state = {"platform": platform, "rows": rows, "leaves": leaves}
+
+    def save_partial(**kw):
+        state.update(kw)
+        try:
+            with open(partial_path, "w") as f:
+                json.dump(state, f)
+        except OSError:
+            pass
+
+    save_partial(stage="warmup")
     t0 = time.time()
-    bst2 = lgb.train(dict(params), ds, num_boost_round=trees)
+    lgb.train(dict(params), ds, num_boost_round=warmup,
+              valid_sets=[vs], valid_names=["v"])
+    compile_s = time.time() - t0
+    sys.stderr.write(f"[bench] warmup ({warmup} trees) in {compile_s:.1f}s\n")
+    save_partial(stage="timed", warmup_s=round(compile_s, 2))
+
+    def progress(env):
+        done = env.iteration + 1
+        if done % 10 == 0 or done == trees:
+            dt = time.time() - t0
+            tps = done / dt if dt > 0 else 0.0
+            sys.stderr.write(f"[bench] {done}/{trees} trees, {tps:.3f} trees/s\n")
+            save_partial(trees_done=done, elapsed_s=round(dt, 2),
+                         trees_per_sec=round(tps, 4))
+
+    t0 = time.time()
+    bst2 = lgb.train(dict(params), ds, num_boost_round=trees,
+                     valid_sets=[vs], valid_names=["v"],
+                     callbacks=[progress])
     dt = time.time() - t0
 
     trees_per_sec = trees / dt
@@ -61,8 +163,8 @@ def main() -> None:
     try:
         from sklearn.metrics import roc_auc_score
 
-        auc = float(roc_auc_score(y[:100000], bst2.predict(X[:100000])))
-    except Exception:
+        auc = float(roc_auc_score(yv, bst2.predict(Xv)))
+    except Exception:  # noqa: BLE001
         pass
 
     out = {
@@ -70,9 +172,11 @@ def main() -> None:
         "value": round(trees_per_sec, 4),
         "unit": "trees/sec",
         "vs_baseline": round(trees_per_sec / baseline_tps, 4),
+        "platform": platform,
     }
     if auc is not None:
-        out["auc_100k"] = round(auc, 5)
+        out["auc_valid"] = round(auc, 5)
+    save_partial(stage="done", **out)
     print(json.dumps(out))
 
 

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Build the reference LightGBM CLI (/root/reference) for the parity
+# harness. The image's cmake (3.25) is older than the reference's
+# cmake_minimum_required (3.28), and the vendored submodules
+# (fmt / fast_double_parser / eigen) are not checked out, so this
+# compiles directly with g++ using the shim headers in this directory
+# (strtod-backed fast_double_parser, snprintf-backed fmt::format_to_n,
+# and an Eigen-free linear_tree stub that aborts if linear_tree=true).
+#
+# Usage: tools/refbuild/build.sh [REFERENCE_DIR] [OUT_DIR]
+set -e
+REF="${1:-/root/reference}"
+OUT="${2:-$(dirname "$0")/../../.refbuild}"
+SHIMS="$(cd "$(dirname "$0")" && pwd)"
+mkdir -p "$OUT"
+cd "$OUT"
+
+if [ -x lightgbm ]; then
+  echo "reference CLI already built: $OUT/lightgbm"
+  exit 0
+fi
+
+ls "$REF"/src/*.cpp "$REF"/src/*/*.cpp 2>/dev/null \
+  | grep -v cuda | grep -v c_api | grep -v linear_tree_learner > srcs.txt
+
+g++ -O2 -std=c++17 -fopenmp \
+  -DUSE_SOCKET -DMM_PREFETCH -DMM_MALLOC \
+  -I"$SHIMS" -I"$REF/include" -I"$REF/src/treelearner" \
+  $(cat srcs.txt) "$SHIMS/linear_tree_learner_stub.cpp" \
+  -o lightgbm -lpthread
+
+echo "built: $OUT/lightgbm"

@@ -1,0 +1,18 @@
+// Build shim for the parity harness: the reference's vendored
+// fast_double_parser submodule is not checked out in this image. Same
+// API, strtod-backed (slower, equally precise).
+#ifndef FAST_DOUBLE_PARSER_SHIM_H_
+#define FAST_DOUBLE_PARSER_SHIM_H_
+#include <cstdlib>
+#include <clocale>
+
+namespace fast_double_parser {
+inline const char* parse_number(const char* p, double* out) {
+  char* end = nullptr;
+  double v = std::strtod(p, &end);
+  if (end == p) return nullptr;
+  *out = v;
+  return end;
+}
+}  // namespace fast_double_parser
+#endif

@@ -1,0 +1,42 @@
+// Build shim for the parity harness: minimal fmt::format_to_n covering
+// exactly the format strings LightGBM's common.h uses ("{}", "{:g}",
+// "{:.17g}"), backed by snprintf. The vendored fmt submodule is not
+// checked out in this image.
+#ifndef FMT_FORMAT_SHIM_H_
+#define FMT_FORMAT_SHIM_H_
+#include <cstdio>
+#include <cstring>
+#include <type_traits>
+
+namespace fmt {
+struct format_to_n_result_shim {
+  size_t size;
+};
+
+inline format_to_n_result_shim format_to_n(char* buf, size_t n,
+                                           const char* f, double v) {
+  int w;
+  if (std::strcmp(f, "{:.17g}") == 0) {
+    w = std::snprintf(buf, n, "%.17g", v);
+  } else if (std::strcmp(f, "{:g}") == 0) {
+    w = std::snprintf(buf, n, "%g", v);
+  } else {
+    w = std::snprintf(buf, n, "%.17g", v);
+  }
+  return {static_cast<size_t>(w < 0 ? n + 1 : w)};
+}
+
+inline format_to_n_result_shim format_to_n(char* buf, size_t n,
+                                           const char* f, float v) {
+  return format_to_n(buf, n, f, static_cast<double>(v));
+}
+
+template <typename T,
+          typename std::enable_if<std::is_integral<T>::value, int>::type = 0>
+inline format_to_n_result_shim format_to_n(char* buf, size_t n,
+                                           const char*, T v) {
+  int w = std::snprintf(buf, n, "%lld", static_cast<long long>(v));
+  return {static_cast<size_t>(w < 0 ? n + 1 : w)};
+}
+}  // namespace fmt
+#endif

@@ -1,0 +1,60 @@
+// Build shim for the parity harness: the reference's linear-tree leaf
+// solver needs Eigen, whose vendored submodule is not checked out in
+// this image. The parity tests never enable linear_tree; any attempt
+// to use it aborts loudly instead of silently degrading.
+#include <LightGBM/utils/log.h>
+
+#include "linear_tree_learner.h"  // via -I<reference>/src/treelearner
+
+namespace LightGBM {
+
+template <typename T>
+void LinearTreeLearner<T>::Init(const Dataset* train_data,
+                                bool is_constant_hessian) {
+  T::Init(train_data, is_constant_hessian);
+  Log::Fatal("linear_tree is unavailable in this shim build (no Eigen)");
+}
+
+template <typename T>
+void LinearTreeLearner<T>::InitLinear(const Dataset*, const int) {
+  Log::Fatal("linear_tree is unavailable in this shim build (no Eigen)");
+}
+
+template <typename T>
+Tree* LinearTreeLearner<T>::Train(const score_t*, const score_t*, bool) {
+  Log::Fatal("linear_tree is unavailable in this shim build (no Eigen)");
+  return nullptr;
+}
+
+template <typename T>
+Tree* LinearTreeLearner<T>::FitByExistingTree(const Tree*, const score_t*,
+                                              const score_t*) const {
+  Log::Fatal("linear_tree is unavailable in this shim build (no Eigen)");
+  return nullptr;
+}
+
+template <typename T>
+Tree* LinearTreeLearner<T>::FitByExistingTree(const Tree*,
+                                              const std::vector<int>&,
+                                              const score_t*,
+                                              const score_t*) const {
+  Log::Fatal("linear_tree is unavailable in this shim build (no Eigen)");
+  return nullptr;
+}
+
+template <typename T>
+void LinearTreeLearner<T>::GetLeafMap(Tree*) const {
+  Log::Fatal("linear_tree is unavailable in this shim build (no Eigen)");
+}
+
+template <typename T>
+template <bool HAS_NAN>
+void LinearTreeLearner<T>::CalculateLinear(Tree*, bool, const score_t*,
+                                           const score_t*, bool) const {
+  Log::Fatal("linear_tree is unavailable in this shim build (no Eigen)");
+}
+
+template class LinearTreeLearner<SerialTreeLearner>;
+template class LinearTreeLearner<GPUTreeLearner>;
+
+}  // namespace LightGBM
