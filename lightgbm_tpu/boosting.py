@@ -83,6 +83,40 @@ class GBDT:
         if train_set is None:
             return  # prediction-only booster (model loaded from file)
 
+        # ---- tree learner selection (reference tree_learner.cpp:17-59):
+        # "data"/"voting" route growth through the sharded grower over a
+        # 1-D device mesh (rows sharded, histograms psum'd over ICI —
+        # data_parallel_tree_learner.cpp:286). Voting's top-k election
+        # exists to cap socket bytes; on a TPU mesh the histogram reduce
+        # is an XLA collective riding ICI, so both configs use the same
+        # reduction (identical results to "data" by construction).
+        self._mesh = None
+        self._dp = None
+        import jax
+
+        n_dev = jax.device_count()
+        if config.tree_learner in ("data", "voting") and n_dev > 1:
+            from .learner.histogram import HIST_BLK
+            from .parallel.data_parallel import make_mesh
+
+            if config.tree_learner == "voting":
+                log.info(
+                    "tree_learner=voting: histogram reduction is an XLA "
+                    "psum over ICI; using the data-parallel grower "
+                    "(identical results)"
+                )
+            self._mesh = make_mesh()
+            blk = HIST_BLK
+            if HIST_BLK % n_dev != 0 or jax.devices()[0].platform == "tpu":
+                blk = HIST_BLK * n_dev  # per-shard rows stay pallas-aligned
+            train_set.ensure_row_block(blk)
+        elif config.tree_learner == "feature" and n_dev > 1:
+            log.warning(
+                "tree_learner=feature is not implemented on the TPU mesh "
+                "yet; falling back to serial (single-device) growth"
+            )
+        # objective/strategy init AFTER ensure_row_block: they cache
+        # padded per-row arrays and must see the final row padding
         if self.objective is not None:
             self.objective.init(train_set)
         self.strategy = create_sample_strategy(config, train_set.num_data)
@@ -91,7 +125,7 @@ class GBDT:
             num_leaves=config.num_leaves,
             num_bins=train_set.max_num_bin,
             max_depth=config.max_depth,
-            axis_name=None,
+            axis_name="data" if self._mesh is not None else None,
         )
         self.params = make_split_params(config)
         self.train = _ScoreSet(
@@ -108,6 +142,39 @@ class GBDT:
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
         self._label_dev = (
             jnp.asarray(train_set.padded(meta.label)) if meta.label is not None else None
+        )
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .parallel.data_parallel import DataParallelGrower
+
+            self._dp = DataParallelGrower(self._mesh, self.spec)
+            self.dev = self._dp.shard_inputs(self.dev)
+            # free the unsharded device copies — this booster reads only
+            # self.dev for the train set; other boosters re-push fresh
+            train_set.invalidate_device_cache()
+            row = NamedSharding(self._mesh, P(None, "data"))
+            self.train.score = jax.device_put(self.train.score, row)
+            if self._label_dev is not None:
+                self._label_dev = jax.device_put(
+                    self._label_dev, NamedSharding(self._mesh, P("data"))
+                )
+
+    # ------------------------------------------------------------------
+    def _grow(self, gk, hk, mask, feat_mask, valid):
+        """Grow one tree on the training set — serial, or sharded over the
+        data mesh when tree_learner=data/voting (lockstep trees on every
+        shard, reference data_parallel_tree_learner.cpp). Traceable: used
+        both eagerly and inside the fused jit step."""
+        d = self.dev
+        if self._dp is not None:
+            return self._dp(
+                d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+                gk, hk, mask, feat_mask, self.params, valid,
+            )
+        return grow_tree(
+            d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+            gk, hk, mask, feat_mask, self.params, self.spec, valid=valid,
         )
 
     # ------------------------------------------------------------------
@@ -304,20 +371,7 @@ class GBDT:
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
             feat_mask = self._sample_features(k=k)
-            arrays, row_leaf = grow_tree(
-                self.dev["bins"],
-                self.dev["nan_bin"],
-                self.dev["num_bins"],
-                self.dev["mono"],
-                self.dev["is_cat"],
-                gk,
-                hk,
-                mask,
-                feat_mask,
-                self.params,
-                self.spec,
-                valid=self.dev["valid"],
-            )
+            arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, self.dev["valid"])
             ok = (arrays.num_nodes > 0).astype(jnp.float32)
             lv = arrays.leaf_value * (self.shrinkage_rate * ok)
             # score updates use the UNBIASED shrunk leaf values — the
@@ -367,20 +421,7 @@ class GBDT:
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
             feat_mask = self._sample_features(k=k)
-            arrays, row_leaf = grow_tree(
-                self.dev["bins"],
-                self.dev["nan_bin"],
-                self.dev["num_bins"],
-                self.dev["mono"],
-                self.dev["is_cat"],
-                gk,
-                hk,
-                mask,
-                feat_mask,
-                self.params,
-                self.spec,
-                valid=self.dev["valid"],
-            )
+            arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, self.dev["valid"])
             n_nodes = int(arrays.num_nodes)
             if n_nodes > 0:
                 should_continue = True
@@ -486,7 +527,9 @@ class GBDT:
             from .device_metrics import supported_names
 
             names, hb = supported_names(ss.metrics)
-            dev = ss.dataset.device_arrays()
+            # the train set's device arrays are self.dev (sharded under a
+            # mesh); don't re-push an unsharded copy through the cache
+            dev = self.dev if ss is self.train else ss.dataset.device_arrays()
             meta = ss.dataset.metadata
             label = jnp.asarray(ss.dataset.padded(meta.label))
             weight = (
@@ -509,8 +552,6 @@ class GBDT:
         objective = self.objective
         strategy = self.strategy
         dev = self.dev
-        spec = self.spec
-        params = self.params
         traverse = traverse_tree_bins
         label_dev = self._label_dev
         track_train_eval = track_train
@@ -536,11 +577,7 @@ class GBDT:
                     feat_mask = jax.random.permutation(fkey, F) < n_feat
                 else:
                     feat_mask = jnp.ones(F, dtype=bool)
-                arrays, row_leaf = grow_tree(
-                    dev["bins"], dev["nan_bin"], dev["num_bins"], dev["mono"],
-                    dev["is_cat"], gk, hk, mask, feat_mask, params, spec,
-                    valid=dev["valid"],
-                )
+                arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, dev["valid"])
                 ok = (arrays.num_nodes > 0).astype(jnp.float32)
                 lv = arrays.leaf_value * (shrink * ok)
                 one = jnp.float32(1.0)
@@ -1140,11 +1177,7 @@ class RF(GBDT):
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
             feat_mask = self._sample_features(k=k)
-            arrays, row_leaf = grow_tree(
-                self.dev["bins"], self.dev["nan_bin"], self.dev["num_bins"],
-                self.dev["mono"], self.dev["is_cat"], gk, hk, mask, feat_mask,
-                self.params, self.spec, valid=self.dev["valid"],
-            )
+            arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, self.dev["valid"])
             n_nodes = int(arrays.num_nodes)
             init_k = self._rf_init_scores[k]
             if n_nodes > 0:

@@ -273,6 +273,21 @@ class BinnedDataset:
         b = self.row_block
         return ((self.num_data + b - 1) // b) * b
 
+    def ensure_row_block(self, blk: int) -> None:
+        """Raise the device row padding so per-shard rows stay a pallas
+        block multiple under a data mesh (data-parallel training). Must
+        run before the first device push; drops any cached arrays."""
+        if self.row_block % blk != 0:
+            g = np.gcd(self.row_block, blk)
+            self.row_block = self.row_block // g * blk
+            self.invalidate_device_cache()
+
+    def invalidate_device_cache(self) -> None:
+        """Drop cached device arrays (next device_arrays() re-pushes).
+        Used when padding changes or when a mesh booster keeps its own
+        sharded copies and the unsharded ones would waste HBM."""
+        self._device = None
+
     # ---------------- device arrays ----------------
     def device_arrays(self) -> Dict[str, Any]:
         """Push the bin matrix + per-feature info to device (cached).

@@ -89,7 +89,8 @@ class DataParallelGrower:
 
         n_dev = self.mesh.devices.size
         n_rows = dev["bins"].shape[1]
-        if (n_rows // n_dev) % HIST_BLK != 0:
+        platform = jax.devices()[0].platform
+        if platform == "tpu" and (n_rows // n_dev) % HIST_BLK != 0:
             from .. import log
 
             log.warning(
