@@ -121,11 +121,19 @@ class GBDT:
             self.objective.init(train_set)
         self.strategy = create_sample_strategy(config, train_set.num_data)
         self.dev = train_set.device_arrays()
+        from .binning import BinType
+
+        cat_subset = any(
+            m.bin_type == BinType.CATEGORICAL
+            and m.num_bin > config.max_cat_to_onehot
+            for m in train_set.used_mappers()
+        )
         self.spec = GrowerSpec(
             num_leaves=config.num_leaves,
             num_bins=train_set.max_num_bin,
             max_depth=config.max_depth,
             axis_name="data" if self._mesh is not None else None,
+            cat_subset=cat_subset,
         )
         self.params = make_split_params(config)
         self.train = _ScoreSet(

@@ -51,6 +51,11 @@ class GrowerSpec(NamedTuple):
     num_bins: int  # uniform bin-axis size B
     max_depth: int  # <= 0 means unlimited
     axis_name: Optional[str] = None
+    # sorted-subset categorical splits (feature_histogram.hpp:449): set
+    # when the dataset has categorical features wider than
+    # max_cat_to_onehot; False keeps every categorical one-vs-rest and
+    # skips the subset scan entirely (no cost for numerical data)
+    cat_subset: bool = False
     # gathered smaller-child histograms: per-split cost tracks leaf size
     # instead of N (the reference's index-list construction,
     # data_partition.hpp); False = masked full scans (simpler, for debug)
@@ -74,6 +79,7 @@ class TreeArrays(NamedTuple):
     node_gain: jax.Array
     node_default_left: jax.Array
     node_cat: jax.Array
+    node_cat_mask: jax.Array  # (L-1, B) bool — cat bins going left
     node_left: jax.Array
     node_right: jax.Array
     node_value: jax.Array  # internal_value: output of the pre-split leaf
@@ -108,16 +114,22 @@ def make_split_params(cfg) -> SplitParams:
         min_gain_to_split=f(cfg.min_gain_to_split),
         max_delta_step=f(cfg.max_delta_step),
         path_smooth=f(cfg.path_smooth),
+        cat_smooth=f(cfg.cat_smooth),
+        cat_l2=f(cfg.cat_l2),
+        max_cat_threshold=jnp.int32(cfg.max_cat_threshold),
+        max_cat_to_onehot=jnp.int32(cfg.max_cat_to_onehot),
+        min_data_per_group=f(cfg.min_data_per_group),
     )
 
 
-def _empty_best(L: int) -> SplitRecord:
+def _empty_best(L: int, B: int) -> SplitRecord:
     zi = jnp.zeros(L, jnp.int32)
     zf = jnp.zeros(L, jnp.float32)
     zb = jnp.zeros(L, bool)
     return SplitRecord(
         gain=jnp.full(L, NEG_INF),
         feature=zi, bin=zi, default_left=zb, is_cat=zb,
+        cat_mask=jnp.zeros((L, B), bool),
         left_g=zf, left_h=zf, left_c=zf,
         right_g=zf, right_h=zf, right_c=zf,
     )
@@ -130,6 +142,7 @@ def _set_best(best: SplitRecord, l: jax.Array, rec: SplitRecord, gain: jax.Array
         bin=best.bin.at[l].set(rec.bin),
         default_left=best.default_left.at[l].set(rec.default_left),
         is_cat=best.is_cat.at[l].set(rec.is_cat),
+        cat_mask=best.cat_mask.at[l].set(rec.cat_mask),
         left_g=best.left_g.at[l].set(rec.left_g),
         left_h=best.left_h.at[l].set(rec.left_h),
         left_c=best.left_c.at[l].set(rec.left_c),
@@ -209,10 +222,10 @@ def _grow_tree_flat(
     hist0 = histogram(bins_fm, gh8, B)
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
-    rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin, mono, is_cat, params, feat_mask)
+    rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin, mono, is_cat, params, feat_mask, cat_subset=spec.cat_subset)
 
     hist = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
-    best = _set_best(_empty_best(L), jnp.int32(0), rec0, rec0.gain)
+    best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
 
     tree = TreeArrays(
         num_nodes=jnp.int32(0),
@@ -221,6 +234,7 @@ def _grow_tree_flat(
         node_gain=jnp.zeros(L - 1, jnp.float32),
         node_default_left=jnp.zeros(L - 1, bool),
         node_cat=jnp.zeros(L - 1, bool),
+        node_cat_mask=jnp.zeros((L - 1, B), bool),
         node_left=jnp.zeros(L - 1, jnp.int32),
         node_right=jnp.zeros(L - 1, jnp.int32),
         node_value=jnp.zeros(L - 1, jnp.float32),
@@ -272,8 +286,16 @@ def _grow_tree_flat(
         node_left = node_left.at[i].set(~l)
         node_right = node_right.at[i].set(~new)
 
-        lo = leaf_output(rec.left_g, rec.left_h, params)
-        ro = leaf_output(rec.right_g, rec.right_h, params)
+        # sorted-subset splits regularize leaf outputs with l2 + cat_l2
+        # (feature_histogram.cpp:251,346); one-hot and numerical use l2
+        cat_p = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
+        is_sub = rec.is_cat & (num_bins[rec.feature] > params.max_cat_to_onehot) if spec.cat_subset else jnp.zeros((), bool)
+        lo = jnp.where(is_sub,
+                       leaf_output(rec.left_g, rec.left_h, cat_p),
+                       leaf_output(rec.left_g, rec.left_h, params))
+        ro = jnp.where(is_sub,
+                       leaf_output(rec.right_g, rec.right_h, cat_p),
+                       leaf_output(rec.right_g, rec.right_h, params))
         depth_new = t.leaf_depth[l] + 1
 
         tree_new = TreeArrays(
@@ -283,9 +305,10 @@ def _grow_tree_flat(
             node_gain=t.node_gain.at[i].set(rec.gain),
             node_default_left=t.node_default_left.at[i].set(rec.default_left),
             node_cat=t.node_cat.at[i].set(rec.is_cat),
+            node_cat_mask=t.node_cat_mask.at[i].set(rec.cat_mask),
             node_left=node_left,
             node_right=node_right,
-            node_value=t.node_value.at[i].set(leaf_output(s.leaf_g[l], s.leaf_h[l], params)),
+            node_value=t.node_value.at[i].set(t.leaf_value[l]),
             node_weight=t.node_weight.at[i].set(s.leaf_h[l]),
             node_count=t.node_count.at[i].set(s.leaf_c[l]),
             leaf_value=t.leaf_value.at[l].set(lo).at[new].set(ro),
@@ -300,7 +323,7 @@ def _grow_tree_flat(
         fnan = nan_bin[f]
         go_left = jnp.where(
             rec.is_cat,
-            fbins == rec.bin,
+            rec.cat_mask[fbins],
             (fbins <= rec.bin) | (rec.default_left & (fbins == fnan) & (fnan >= 0)),
         )
         on_leaf = s.row_leaf == l
@@ -359,9 +382,11 @@ def _grow_tree_flat(
 
         # ---- best splits for both children ----
         bl = best_split(left_hist, rec.left_g, rec.left_h, rec.left_c,
-                        num_bins, nan_bin, mono, is_cat, params, feat_mask)
+                        num_bins, nan_bin, mono, is_cat, params, feat_mask,
+                        cat_subset=spec.cat_subset)
         br = best_split(right_hist, rec.right_g, rec.right_h, rec.right_c,
-                        num_bins, nan_bin, mono, is_cat, params, feat_mask)
+                        num_bins, nan_bin, mono, is_cat, params, feat_mask,
+                        cat_subset=spec.cat_subset)
         depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
         best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
         best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))

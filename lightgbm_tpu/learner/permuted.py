@@ -79,7 +79,7 @@ class _PState(NamedTuple):
 def _go_left(fbins, rec, fnan):
     return jnp.where(
         rec.is_cat,
-        fbins == rec.bin,
+        rec.cat_mask[fbins],
         (fbins <= rec.bin) | (rec.default_left & (fbins == fnan) & (fnan >= 0)),
     )
 
@@ -113,10 +113,11 @@ def grow_tree_permuted(
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
     rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin,
-                      mono, is_cat, params, feat_mask)
+                      mono, is_cat, params, feat_mask,
+                      cat_subset=spec.cat_subset)
 
     hist = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
-    best = _set_best(_empty_best(L), jnp.int32(0), rec0, rec0.gain)
+    best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
 
     tree = TreeArrays(
         num_nodes=jnp.int32(0),
@@ -125,6 +126,7 @@ def grow_tree_permuted(
         node_gain=jnp.zeros(L - 1, jnp.float32),
         node_default_left=jnp.zeros(L - 1, bool),
         node_cat=jnp.zeros(L - 1, bool),
+        node_cat_mask=jnp.zeros((L - 1, B), bool),
         node_left=jnp.zeros(L - 1, jnp.int32),
         node_right=jnp.zeros(L - 1, jnp.int32),
         node_value=jnp.zeros(L - 1, jnp.float32),
@@ -178,8 +180,16 @@ def grow_tree_permuted(
         node_left = node_left.at[i].set(~l)
         node_right = node_right.at[i].set(~new)
 
-        lo = leaf_output(rec.left_g, rec.left_h, params)
-        ro = leaf_output(rec.right_g, rec.right_h, params)
+        # sorted-subset splits regularize leaf outputs with l2 + cat_l2
+        # (feature_histogram.cpp:251,346); one-hot and numerical use l2
+        cat_p = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
+        is_sub = rec.is_cat & (num_bins[rec.feature] > params.max_cat_to_onehot) if spec.cat_subset else jnp.zeros((), bool)
+        lo = jnp.where(is_sub,
+                       leaf_output(rec.left_g, rec.left_h, cat_p),
+                       leaf_output(rec.left_g, rec.left_h, params))
+        ro = jnp.where(is_sub,
+                       leaf_output(rec.right_g, rec.right_h, cat_p),
+                       leaf_output(rec.right_g, rec.right_h, params))
         depth_new = t.leaf_depth[l] + 1
 
         tree_new = TreeArrays(
@@ -189,9 +199,10 @@ def grow_tree_permuted(
             node_gain=t.node_gain.at[i].set(rec.gain),
             node_default_left=t.node_default_left.at[i].set(rec.default_left),
             node_cat=t.node_cat.at[i].set(rec.is_cat),
+            node_cat_mask=t.node_cat_mask.at[i].set(rec.cat_mask),
             node_left=node_left,
             node_right=node_right,
-            node_value=t.node_value.at[i].set(leaf_output(s.leaf_g[l], s.leaf_h[l], params)),
+            node_value=t.node_value.at[i].set(t.leaf_value[l]),
             node_weight=t.node_weight.at[i].set(s.leaf_h[l]),
             node_count=t.node_count.at[i].set(s.leaf_c[l]),
             leaf_value=t.leaf_value.at[l].set(lo).at[new].set(ro),
@@ -285,9 +296,11 @@ def grow_tree_permuted(
 
         # ---- best splits for both children ----
         bl = best_split(left_hist, rec.left_g, rec.left_h, rec.left_c,
-                        num_bins, nan_bin, mono, is_cat, params, feat_mask)
+                        num_bins, nan_bin, mono, is_cat, params, feat_mask,
+                        cat_subset=spec.cat_subset)
         br = best_split(right_hist, rec.right_g, rec.right_h, rec.right_c,
-                        num_bins, nan_bin, mono, is_cat, params, feat_mask)
+                        num_bins, nan_bin, mono, is_cat, params, feat_mask,
+                        cat_subset=spec.cat_subset)
         depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
         best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
         best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))

@@ -43,6 +43,12 @@ class SplitParams(NamedTuple):
     min_gain_to_split: jax.Array
     max_delta_step: jax.Array
     path_smooth: jax.Array
+    # categorical sorted-subset params (feature_histogram.hpp:449+)
+    cat_smooth: jax.Array
+    cat_l2: jax.Array
+    max_cat_threshold: jax.Array  # int32
+    max_cat_to_onehot: jax.Array  # int32
+    min_data_per_group: jax.Array
 
 
 class SplitRecord(NamedTuple):
@@ -53,6 +59,7 @@ class SplitRecord(NamedTuple):
     bin: jax.Array  # int32 threshold bin (or category bin for 1-vs-rest)
     default_left: jax.Array  # bool
     is_cat: jax.Array  # bool
+    cat_mask: jax.Array  # (B,) bool — cat bins going LEFT (subset splits)
     left_g: jax.Array
     left_h: jax.Array
     left_c: jax.Array
@@ -87,6 +94,105 @@ def leaf_gain(g: jax.Array, h: jax.Array, p: SplitParams) -> jax.Array:
     return jnp.where(p.max_delta_step > 0.0, clipped, free)
 
 
+def _cat_subset_scan(g, h, c, num_bins, nan_bin, is_cat, sum_g, sum_h, sum_c, params):
+    """Sorted-subset categorical split search (feature_histogram.cpp:246+
+    FindBestThresholdCategoricalInner, non-onehot branch), vectorized over
+    features with the per-bin scan expressed as cumulative sums:
+
+    - valid bins: count >= cat_smooth (the reference compares the
+      hessian-estimated count; we have exact counts),
+    - stable sort by g/(h + cat_smooth) ascending,
+    - two scans (ascending / descending prefixes), prefix length capped
+      at max_num_cat = min(max_cat_threshold, (used+1)/2),
+    - l2 + cat_l2 regularization,
+    - min_data_per_group batching: gain is only evaluated when at least
+      min_data_per_group rows accumulated since the last evaluation
+      (sequential reset -> lax.scan over the bin axis),
+    - break conditions (right side too small) are monotone in the prefix
+      length, so they become masks.
+
+    Returns (gains (F, B, 2), ok (F, B, 2), sums (3, F, B, 2),
+    inv_rank (F, B), valid_bin (F, B)); direction 0 = ascending prefix,
+    1 = descending. The left set for candidate (f, i, dir) is
+    {b : valid_bin[f,b] and (inv_rank[f,b] <= i if dir==0 else
+    inv_rank[f,b] >= used[f]-1-i)}.
+    """
+    from jax import lax
+
+    F, B = g.shape
+    bidx = jnp.arange(B)[None, :]
+    valid_bin = (
+        (c >= params.cat_smooth)
+        & is_cat[:, None]
+        & (bidx < num_bins[:, None])
+        # the NaN bin is not a category: prediction (host Tree / device
+        # traversal via the same mask) always routes missing right
+        & (bidx != nan_bin[:, None])
+    )
+    ratio = jnp.where(valid_bin, g / (h + params.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1, stable=True)  # (F, B) invalid last
+    inv_rank = jnp.argsort(order, axis=1)  # rank of each bin in the sort
+    used = jnp.sum(valid_bin, axis=1).astype(jnp.int32)  # (F,)
+
+    vf = jnp.take_along_axis(valid_bin, order, axis=1)
+    sg = jnp.where(vf, jnp.take_along_axis(g, order, axis=1), 0.0)
+    sh = jnp.where(vf, jnp.take_along_axis(h, order, axis=1), 0.0)
+    sc = jnp.where(vf, jnp.take_along_axis(c, order, axis=1), 0.0)
+
+    # direction 0: ascending prefixes; direction 1: descending prefixes
+    sg2 = jnp.stack([sg, sg[:, ::-1]], axis=-1)  # (F, B, 2)
+    sh2 = jnp.stack([sh, sh[:, ::-1]], axis=-1)
+    sc2 = jnp.stack([sc, sc[:, ::-1]], axis=-1)
+    # descending prefixes start from the END of the VALID region: roll the
+    # reversed arrays so sorted-last valid bins come first
+    shift = (B - used)[:, None, None]
+    idx = (jnp.arange(B)[None, :, None] + shift) % B
+    sg2 = sg2.at[:, :, 1].set(jnp.take_along_axis(sg2[:, :, 1:2], idx, axis=1)[:, :, 0])
+    sh2 = sh2.at[:, :, 1].set(jnp.take_along_axis(sh2[:, :, 1:2], idx, axis=1)[:, :, 0])
+    sc2 = sc2.at[:, :, 1].set(jnp.take_along_axis(sc2[:, :, 1:2], idx, axis=1)[:, :, 0])
+
+    lg = jnp.cumsum(sg2, axis=1)
+    lh = jnp.cumsum(sh2, axis=1) + K_EPSILON
+    lc = jnp.cumsum(sc2, axis=1)
+    rg = sum_g - lg
+    rh = sum_h - lh
+    rc = sum_c - lc
+
+    i_idx = jnp.arange(B, dtype=jnp.int32)[None, :, None]
+    max_num_cat = jnp.minimum(params.max_cat_threshold, (used[:, None, None] + 1) // 2)
+    pos_ok = (i_idx < max_num_cat) & (i_idx < used[:, None, None])
+
+    # continue conditions (skip eval, keep accumulating group)
+    c2 = (lc < params.min_data_in_leaf) | (lh < params.min_sum_hessian_in_leaf)
+    # break conditions (monotone in i): stop this direction entirely
+    brk = (
+        (rc < params.min_data_in_leaf)
+        | (rc < params.min_data_per_group)
+        | (rh < params.min_sum_hessian_in_leaf)
+    )
+    brk = jnp.cumsum(brk.astype(jnp.int32), axis=1) > 0
+
+    # min_data_per_group batching: sequential reset per (feature, dir)
+    def step(grp, x):
+        sc_i, skip_i, brk_i = x
+        grp = grp + sc_i
+        do_eval = (~skip_i) & (~brk_i) & (grp >= params.min_data_per_group)
+        return jnp.where(do_eval, 0.0, grp), do_eval
+
+    xs = (
+        jnp.moveaxis(sc2, 1, 0),  # (B, F, 2)
+        jnp.moveaxis(c2, 1, 0),
+        jnp.moveaxis(brk, 1, 0),
+    )
+    _, do_eval = lax.scan(step, jnp.zeros((F, 2)), xs)
+    do_eval = jnp.moveaxis(do_eval, 0, 1)  # (F, B, 2)
+
+    cat_params = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
+    gains = leaf_gain(lg, lh, cat_params) + leaf_gain(rg, rh, cat_params)
+    ok = do_eval & pos_ok
+    return gains, ok, jnp.stack([lg, lh, lc]), inv_rank, valid_bin, used
+
+
 def best_split(
     hist: jax.Array,  # (3, F, B) f32 — (grad, hess, count) channels
     sum_g: jax.Array,
@@ -98,6 +204,7 @@ def best_split(
     is_cat: jax.Array,  # (F,) bool
     params: SplitParams,
     feat_mask: Optional[jax.Array] = None,  # (F,) bool — ColSampler feature_fraction
+    cat_subset: bool = False,  # static: dataset has large-cardinality cats
 ) -> SplitRecord:
     """Find the best split of a leaf with given histogram and totals."""
     _, F, B = hist.shape
@@ -150,18 +257,40 @@ def best_split(
     ok_dr &= num_mask
     ok_dl &= num_mask
 
-    # ---- categorical one-vs-rest: bin t alone goes left.
+    # ---- categorical one-vs-rest: bin t alone goes left. With the
+    # sorted-subset path enabled, one-hot applies only to features with
+    # num_bin <= max_cat_to_onehot (feature_histogram.cpp:182 use_onehot);
+    # without it (legacy callers) every categorical stays one-vs-rest.
     gain_cat, ok_cat, _ = eval_lr(g, h, c)
-    ok_cat &= is_cat[:, None] & (bin_idx < num_bins[:, None])
+    ok_cat &= (
+        is_cat[:, None]
+        & (bin_idx < num_bins[:, None])
+        & (bin_idx != nan_bin[:, None])
+    )
+    if cat_subset:
+        ok_cat &= (num_bins <= params.max_cat_to_onehot)[:, None]
 
     parent_gain = leaf_gain(sum_g, sum_h, params)
     shift = parent_gain + params.min_gain_to_split
 
-    # stack: dir axis LAST in flat order (F, B, 3) so ties break on
-    # feature, then bin, then (dr, dl, cat) — reference scans features in
-    # order and keeps strictly-greater gains.
-    gains = jnp.stack([gain_dr, gain_dl, gain_cat], axis=-1) - shift  # (F, B, 3)
-    ok = jnp.stack([ok_dr, ok_dl, ok_cat], axis=-1)
+    # stack: dir axis LAST in flat order (F, B, D) so ties break on
+    # feature, then bin, then (dr, dl, cat[, cat_asc, cat_desc]).
+    # Deviation from the reference on EXACT float ties only: it scans all
+    # ascending subset prefixes before any descending one
+    # (feature_histogram.cpp:276), while this order interleaves
+    # directions per prefix length.
+    dirs = [gain_dr, gain_dl, gain_cat]
+    oks = [ok_dr, ok_dl, ok_cat]
+    if cat_subset:
+        big = is_cat & (num_bins > params.max_cat_to_onehot)
+        cs_gain, cs_ok, cs_sums, inv_rank, valid_bin, cs_used = _cat_subset_scan(
+            g, h, c, num_bins, nan_bin, big, sum_g, sum_h, sum_c, params
+        )
+        dirs += [cs_gain[:, :, 0], cs_gain[:, :, 1]]
+        oks += [cs_ok[:, :, 0], cs_ok[:, :, 1]]
+    D = len(dirs)
+    gains = jnp.stack(dirs, axis=-1) - shift  # (F, B, D)
+    ok = jnp.stack(oks, axis=-1)
     if feat_mask is not None:
         ok &= feat_mask[:, None, None]
     gains = jnp.where(ok, gains, NEG_INF)
@@ -169,11 +298,11 @@ def best_split(
     flat = gains.reshape(-1)
     idx = jnp.argmax(flat)
     best_gain = flat[idx]
-    f = (idx // (B * 3)).astype(jnp.int32)
-    b = ((idx // 3) % B).astype(jnp.int32)
-    d = (idx % 3).astype(jnp.int32)
+    f = (idx // (B * D)).astype(jnp.int32)
+    b = ((idx // D) % B).astype(jnp.int32)
+    d = (idx % D).astype(jnp.int32)
     default_left = d == 1
-    cat = d == 2
+    cat = d >= 2
 
     lg_num = cg[f, b] + jnp.where(default_left, nan_g[f, 0], 0.0)
     lh_num = ch[f, b] + jnp.where(default_left, nan_h[f, 0], 0.0)
@@ -181,6 +310,20 @@ def best_split(
     lg = jnp.where(cat, g[f, b], lg_num)
     lh = jnp.where(cat, h[f, b], lh_num)
     lc = jnp.where(cat, c[f, b], lc_num)
+    # one-hot left set: the single winning bin
+    cat_mask = (jnp.arange(B, dtype=jnp.int32) == b) & cat
+
+    if cat_subset:
+        is_sub = d >= 3
+        asc = d == 3
+        lg = jnp.where(is_sub, cs_sums[0, f, b, d - 3], lg)
+        lh = jnp.where(is_sub, cs_sums[1, f, b, d - 3], lh)
+        lc = jnp.where(is_sub, cs_sums[2, f, b, d - 3], lc)
+        rank_f = inv_rank[f]
+        sub_mask = jnp.where(
+            asc, rank_f <= b, rank_f >= cs_used[f] - 1 - b
+        ) & valid_bin[f]
+        cat_mask = jnp.where(is_sub, sub_mask, cat_mask)
 
     return SplitRecord(
         gain=best_gain,
@@ -188,6 +331,7 @@ def best_split(
         bin=b,
         default_left=default_left,
         is_cat=cat,
+        cat_mask=cat_mask,
         left_g=lg,
         left_h=lh,
         left_c=lc,

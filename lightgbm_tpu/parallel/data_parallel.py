@@ -118,7 +118,9 @@ def _tree_arrays_structure(spec: GrowerSpec) -> TreeArrays:
         num_nodes=z((), jnp.int32),
         node_feature=z(L - 1, jnp.int32), node_bin=z(L - 1, jnp.int32),
         node_gain=z(L - 1, jnp.float32), node_default_left=z(L - 1, bool),
-        node_cat=z(L - 1, bool), node_left=z(L - 1, jnp.int32),
+        node_cat=z(L - 1, bool),
+        node_cat_mask=z((L - 1, spec.num_bins), bool),
+        node_left=z(L - 1, jnp.int32),
         node_right=z(L - 1, jnp.int32), node_value=z(L - 1, jnp.float32),
         node_weight=z(L - 1, jnp.float32), node_count=z(L - 1, jnp.float32),
         leaf_value=z(L, jnp.float32), leaf_weight=z(L, jnp.float32),

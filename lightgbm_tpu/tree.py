@@ -76,6 +76,7 @@ class Tree:
         nb = np.asarray(arrays.node_bin[:n_nodes])
         ndl = np.asarray(arrays.node_default_left[:n_nodes])
         ncat = np.asarray(arrays.node_cat[:n_nodes])
+        ncat_mask = np.asarray(arrays.node_cat_mask[:n_nodes]) if ncat.any() else None
 
         t.split_feature = used[nf].astype(np.int32) if n_nodes else np.zeros(0, np.int32)
         t.split_gain = np.asarray(arrays.node_gain[:n_nodes], dtype=np.float64)
@@ -107,11 +108,21 @@ class Tree:
             # milestone (feature_histogram.hpp:832 NA_AS_MISSING path).
             if ncat[i]:
                 dt |= _CAT_MASK
-                # one-vs-rest: bitset holding the single left-going category
-                cat_val = int(m.categories[int(nb[i])]) if int(nb[i]) < len(m.categories) else 0
-                n_words = cat_val // 32 + 1
+                # bitset over the left-going category VALUES (one for
+                # one-vs-rest, several for sorted-subset splits —
+                # tree.h cat_threshold_ layout)
+                bins_left = np.nonzero(ncat_mask[i])[0]
+                cat_vals = [
+                    int(m.categories[bl])
+                    for bl in bins_left
+                    if bl < len(m.categories)
+                ]
+                # empty set degenerates to an all-right bitset (never a
+                # valid split; kept loud-safe rather than guessing a bin)
+                n_words = (max(cat_vals) // 32 + 1) if cat_vals else 1
                 words = [0] * n_words
-                words[cat_val // 32] |= 1 << (cat_val % 32)
+                for cv in cat_vals:
+                    words[cv // 32] |= 1 << (cv % 32)
                 thresholds[i] = float(n_cat)  # index into cat_boundaries
                 cat_threshold.extend(np.uint32(w) for w in words)
                 cat_boundaries.append(len(cat_threshold))
@@ -255,7 +266,7 @@ def traverse_tree_bins(arrays: "TreeArrays", bins_fm, nan_bin):
         fnan = nan_bin[f]
         go_left = jnp.where(
             arrays.node_cat[k],
-            fbins == arrays.node_bin[k],
+            arrays.node_cat_mask[k][fbins],
             (fbins <= arrays.node_bin[k])
             | (arrays.node_default_left[k] & (fbins == fnan) & (fnan >= 0)),
         )

@@ -16,10 +16,18 @@ def _params(**kw):
     d = dict(
         lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=1.0,
         min_sum_hessian_in_leaf=0.0, min_gain_to_split=0.0,
-        max_delta_step=0.0, path_smooth=0.0,
+        max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
+        cat_l2=10.0, min_data_per_group=100.0,
     )
+    ints = dict(max_cat_threshold=32, max_cat_to_onehot=4)
+    for k in list(kw):
+        if k in ints:
+            ints[k] = kw.pop(k)
     d.update(kw)
-    return SplitParams(**{k: jnp.float32(v) for k, v in d.items()})
+    return SplitParams(
+        **{k: jnp.float32(v) for k, v in d.items()},
+        **{k: jnp.int32(v) for k, v in ints.items()},
+    )
 
 
 def _mk_problem(n=1024, F=4, B=16, seed=0):
