@@ -142,6 +142,20 @@ class Dataset:
         return self
 
     # ------------------------------------------------------------------
+    @classmethod
+    def from_binned(cls, binned) -> "Dataset":
+        """Wrap an already-binned dataset (the .bin cache fast path,
+        reference dataset_loader.cpp:424 LoadFromBinFile)."""
+        ds = cls(data=None, free_raw_data=True)
+        ds.label = binned.metadata.label
+        ds.weight = binned.metadata.weight
+        ds.group = binned.metadata.group
+        ds.init_score = binned.metadata.init_score
+        ds.feature_name = binned.feature_names
+        ds._binned = binned
+        return ds
+
+    # ------------------------------------------------------------------
     def create_valid(
         self, data, label=None, weight=None, group=None, init_score=None,
         params=None, position=None,

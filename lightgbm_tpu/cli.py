@@ -1,0 +1,249 @@
+"""Config-file-driven command line application.
+
+Reference surface: src/main.cpp:14 + src/application/application.cpp —
+`lightgbm config=train.conf [k=v ...]` with tasks train / predict /
+save_binary / convert_model / refit (config.h:35 TaskType). Parameter
+layering matches Application::LoadParameters (application.cpp:53-89):
+command-line pairs first, then `config=` file lines (k = v, `#`
+comments), FIRST occurrence of a key wins (config.cpp KeepFirstValues).
+
+Run as `python -m lightgbm_tpu config=train.conf` (or the bin/lightgbm
+wrapper). The reference's example train.conf files run unmodified.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import log
+
+
+def parse_kv_args(argv: List[str]) -> Dict[str, str]:
+    """argv 'k=v' pairs + config= file lines; first occurrence wins."""
+    params: Dict[str, str] = {}
+
+    def add(k: str, v: str) -> None:
+        k = k.strip()
+        v = v.strip().strip('"').strip("'")
+        if k and k not in params:
+            params[k] = v
+
+    for arg in argv:
+        if "=" in arg:
+            k, v = arg.split("=", 1)
+            add(k, v)
+    cfg = params.get("config", "")
+    if cfg:
+        if not Path(cfg).exists():
+            log.fatal(f"config file {cfg} does not exist")
+        for line in Path(cfg).read_text().splitlines():
+            if "#" in line:
+                line = line[: line.index("#")]
+            line = line.strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            add(k, v)
+    params.pop("config", None)
+    return params
+
+
+_DATA_KEYS = (
+    "header", "label_column", "weight_column", "group_column",
+    "ignore_column", "categorical_feature",
+)
+
+
+def _mappers_equal(a, b) -> bool:
+    if len(a) != len(b):
+        return False
+    for ma, mb in zip(a, b):
+        if (
+            ma.num_bin != mb.num_bin
+            or ma.bin_type != mb.bin_type
+            or ma.categories != mb.categories
+            or not np.array_equal(ma.upper_bounds, mb.upper_bounds)
+        ):
+            return False
+    return True
+
+
+def _load_dataset(params: Dict[str, str], path: str, reference=None):
+    """Text or .bin cache -> lgb.Dataset (constructed)."""
+    from . import Dataset
+    from .parsers import is_binary_file, load_binary, load_text_file
+
+    if is_binary_file(path):
+        log.info(f"Loading binary dataset cache {path}")
+        binned = load_binary(path)
+        if reference is not None:
+            # a valid set must share the training set's bin mappers
+            # (reference DatasetLoader::LoadFromFileAlignWithOtherDataset);
+            # a cache binned independently would silently corrupt eval
+            reference.construct()
+            if not _mappers_equal(binned.mappers, reference._binned.mappers):
+                log.fatal(
+                    f"binary cache {path} was binned with different bin "
+                    "mappers than the training data; rebuild it with "
+                    "task=save_binary against this training set"
+                )
+        return Dataset.from_binned(binned)
+
+    loaded = load_text_file(
+        path,
+        header=str(params.get("header", "false")).lower() in ("true", "1"),
+        label_column=params.get("label_column", 0),
+        weight_column=params.get("weight_column", ""),
+        group_column=params.get("group_column", ""),
+        ignore_column=params.get("ignore_column", ""),
+        categorical_feature=params.get("categorical_feature", ""),
+    )
+    train_params = {
+        k: v for k, v in params.items() if k not in _DATA_KEYS
+    }
+    ds = Dataset(
+        loaded["X"],
+        label=loaded["label"],
+        weight=loaded["weight"],
+        group=loaded["group"],
+        init_score=loaded["init_score"],
+        feature_name=loaded["feature_names"] or "auto",
+        categorical_feature=loaded["categorical_feature"] or "auto",
+        params=train_params,
+        reference=reference,
+        free_raw_data=False,
+    )
+    return ds
+
+
+def _task_train(params: Dict[str, str]) -> None:
+    from . import train as lgb_train
+    from .config import Config
+
+    data_path = params.get("data", "")
+    if not data_path:
+        log.fatal("No training/prediction data, application quit")
+    t0 = time.time()
+    ds = _load_dataset(params, data_path)
+    ds.construct()
+    log.info(
+        f"Loaded {ds.num_data()} rows x {ds.num_feature()} features "
+        f"from {data_path} in {time.time()-t0:.1f}s"
+    )
+
+    if str(params.get("is_save_binary_file", params.get("save_binary", "false"))).lower() in ("true", "1"):
+        from .parsers import save_binary
+
+        save_binary(ds._binned, data_path + ".bin")
+        log.info(f"Saved binary cache to {data_path}.bin")
+
+    valid_sets = []
+    valid_names = []
+    vpaths = [v for v in str(params.get("valid_data", params.get("valid", ""))).split(",") if v]
+    for i, vp in enumerate(vpaths):
+        vs = _load_dataset(params, vp, reference=ds)
+        valid_sets.append(vs)
+        valid_names.append(f"valid_{i + 1}")  # reference naming: valid_1, ...
+
+    cfg = Config(dict(params))
+    if str(params.get("is_training_metric", params.get("train_metric", "false"))).lower() in ("true", "1"):
+        valid_sets = [ds] + valid_sets
+        valid_names = ["training"] + valid_names
+
+    num_rounds = cfg.num_iterations
+    booster = lgb_train(
+        dict(params), ds, num_boost_round=num_rounds,
+        valid_sets=valid_sets, valid_names=valid_names,
+    )
+    out = params.get("output_model", "LightGBM_model.txt")
+    booster.save_model(out)
+    log.info(f"Finished training; model saved to {out}")
+
+
+def _task_predict(params: Dict[str, str]) -> None:
+    from . import Booster
+    from .parsers import load_text_file
+
+    data_path = params.get("data", "")
+    model_path = params.get("input_model", "LightGBM_model.txt")
+    if not data_path:
+        log.fatal("No training/prediction data, application quit")
+    if not Path(model_path).exists():
+        log.fatal(f"input model {model_path} does not exist")
+    bst = Booster(model_file=model_path)
+    loaded = load_text_file(
+        data_path,
+        header=str(params.get("header", "false")).lower() in ("true", "1"),
+        label_column=params.get("label_column", 0),
+        weight_column=params.get("weight_column", ""),
+        group_column=params.get("group_column", ""),
+        ignore_column=params.get("ignore_column", ""),
+    )
+    raw = str(params.get("predict_raw_score", "false")).lower() in ("true", "1")
+    leaf = str(params.get("predict_leaf_index", "false")).lower() in ("true", "1")
+    contrib = str(params.get("predict_contrib", "false")).lower() in ("true", "1")
+    pred = bst.predict(
+        loaded["X"], raw_score=raw, pred_leaf=leaf, pred_contrib=contrib
+    )
+    out = params.get("output_result", "LightGBM_predict_result.txt")
+    pred2 = np.atleast_2d(pred.T).T  # (N, K) even for 1-D
+    np.savetxt(out, pred2, delimiter="\t", fmt="%.9g")
+    log.info(f"Finished prediction; results saved to {out}")
+
+
+def _task_save_binary(params: Dict[str, str]) -> None:
+    from .parsers import save_binary
+
+    data_path = params.get("data", "")
+    if not data_path:
+        log.fatal("No training/prediction data, application quit")
+    ds = _load_dataset(params, data_path)
+    ds.construct()
+    out = params.get("output_model", data_path + ".bin")
+    save_binary(ds._binned, out)
+    log.info(f"Finished saving binary dataset cache to {out}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    params = parse_kv_args(argv)
+    # device_type=cpu (alias device=cpu, reference config.h device_type)
+    # steers the run onto the CPU backend. Set at the jax-config level:
+    # the ambient axon plugin force-sets jax_platforms at interpreter
+    # start, so an env var cannot override it from outside.
+    device = params.get("device_type", params.get("device", ""))
+    if device == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if not params:
+        print(
+            "usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
+            "tasks: train (default), predict, save_binary",
+            file=sys.stderr,
+        )
+        return 1
+    task = params.get("task", "train")
+    t0 = time.time()
+    if task == "train":
+        _task_train(params)
+    elif task in ("predict", "prediction", "test"):
+        _task_predict(params)
+    elif task == "save_binary":
+        _task_save_binary(params)
+    elif task in ("convert_model", "refit", "refit_tree"):
+        log.fatal(f"task {task} is not implemented yet")
+    else:
+        log.fatal(f"Unknown task {task}")
+    log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

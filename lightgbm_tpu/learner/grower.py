@@ -122,6 +122,23 @@ def make_split_params(cfg) -> SplitParams:
     )
 
 
+def split_leaf_outputs(rec: SplitRecord, params: SplitParams, num_bins,
+                       use_cat_subset: bool):
+    """Left/right child outputs for a chosen split. Sorted-subset
+    categorical splits regularize with l2 + cat_l2
+    (feature_histogram.cpp:251,346); one-hot and numerical use l2."""
+    if use_cat_subset:
+        is_sub = rec.is_cat & (num_bins[rec.feature] > params.max_cat_to_onehot)
+        p = params._replace(
+            lambda_l2=params.lambda_l2 + jnp.where(is_sub, params.cat_l2, 0.0)
+        )
+    else:
+        p = params
+    return leaf_output(rec.left_g, rec.left_h, p), leaf_output(
+        rec.right_g, rec.right_h, p
+    )
+
+
 def _empty_best(L: int, B: int) -> SplitRecord:
     zi = jnp.zeros(L, jnp.int32)
     zf = jnp.zeros(L, jnp.float32)
@@ -286,16 +303,7 @@ def _grow_tree_flat(
         node_left = node_left.at[i].set(~l)
         node_right = node_right.at[i].set(~new)
 
-        # sorted-subset splits regularize leaf outputs with l2 + cat_l2
-        # (feature_histogram.cpp:251,346); one-hot and numerical use l2
-        cat_p = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
-        is_sub = rec.is_cat & (num_bins[rec.feature] > params.max_cat_to_onehot) if spec.cat_subset else jnp.zeros((), bool)
-        lo = jnp.where(is_sub,
-                       leaf_output(rec.left_g, rec.left_h, cat_p),
-                       leaf_output(rec.left_g, rec.left_h, params))
-        ro = jnp.where(is_sub,
-                       leaf_output(rec.right_g, rec.right_h, cat_p),
-                       leaf_output(rec.right_g, rec.right_h, params))
+        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset)
         depth_new = t.leaf_depth[l] + 1
 
         tree_new = TreeArrays(

@@ -44,6 +44,7 @@ from .grower import (
     _empty_best,
     _get_best,
     _set_best,
+    split_leaf_outputs,
 )
 
 
@@ -180,16 +181,7 @@ def grow_tree_permuted(
         node_left = node_left.at[i].set(~l)
         node_right = node_right.at[i].set(~new)
 
-        # sorted-subset splits regularize leaf outputs with l2 + cat_l2
-        # (feature_histogram.cpp:251,346); one-hot and numerical use l2
-        cat_p = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
-        is_sub = rec.is_cat & (num_bins[rec.feature] > params.max_cat_to_onehot) if spec.cat_subset else jnp.zeros((), bool)
-        lo = jnp.where(is_sub,
-                       leaf_output(rec.left_g, rec.left_h, cat_p),
-                       leaf_output(rec.left_g, rec.left_h, params))
-        ro = jnp.where(is_sub,
-                       leaf_output(rec.right_g, rec.right_h, cat_p),
-                       leaf_output(rec.right_g, rec.right_h, params))
+        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset)
         depth_new = t.leaf_depth[l] + 1
 
         tree_new = TreeArrays(

@@ -29,7 +29,10 @@ from typing import Dict, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-1e30)
+# plain float (NOT jnp.float32): a module-level device constant would
+# initialize the jax backend at import time — which contacts the TPU
+# tunnel before the CLI can steer the run onto another platform
+NEG_INF = -1e30
 K_EPSILON = 1e-15  # reference kEpsilon (meta.h)
 
 

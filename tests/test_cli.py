@@ -1,0 +1,157 @@
+"""CLI application + text parsers + .bin dataset cache.
+
+The reference's example train.conf files must run unmodified
+(application.cpp:34; north-star entry-point parity), prediction output
+must match the Python API, and the .bin cache must round-trip."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+REF = Path(os.environ.get("REFERENCE_DIR", "/root/reference"))
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import main as cli_main, parse_kv_args
+from lightgbm_tpu.parsers import (
+    detect_format,
+    is_binary_file,
+    load_binary,
+    load_text_file,
+    save_binary,
+)
+
+
+def test_parse_kv_args_layering(tmp_path):
+    conf = tmp_path / "c.conf"
+    conf.write_text(
+        "num_leaves = 31  # comment\n# full comment line\nmetric = auc\n"
+        "learning_rate=0.2\n"
+    )
+    # CLI pairs win over config file pairs (first occurrence wins)
+    p = parse_kv_args([f"config={conf}", "num_leaves=7", "task=train"])
+    assert p["num_leaves"] == "7"
+    assert p["metric"] == "auc"
+    assert p["learning_rate"] == "0.2"
+    assert p["task"] == "train"
+    assert "config" not in p
+
+
+def test_detect_format():
+    assert detect_format(["1\t2.0\t3.5", "0\t1.0\t2.5"]) == "tsv"
+    assert detect_format(["1,2.0,3.5"]) == "csv"
+    assert detect_format(["1 1:0.5 4:2.0", "0 2:1.0"]) == "libsvm"
+
+
+def test_load_libsvm(tmp_path):
+    f = tmp_path / "d.svm"
+    f.write_text("1 0:0.5 2:2.0\n0 1:1.5\n1 2:3.0\n")
+    out = load_text_file(str(f))
+    np.testing.assert_array_equal(out["label"], [1, 0, 1])
+    assert out["X"].shape == (3, 3)
+    assert out["X"][0, 0] == 0.5 and out["X"][1, 1] == 1.5 and out["X"][2, 2] == 3.0
+
+
+def test_load_tsv_with_sidecars(tmp_path):
+    f = tmp_path / "d.tsv"
+    rs = np.random.RandomState(0)
+    data = np.column_stack([rs.randint(0, 2, 20), rs.randn(20, 3)])
+    np.savetxt(f, data, delimiter="\t", fmt="%.6f")
+    np.savetxt(tmp_path / "d.tsv.weight", rs.rand(20), fmt="%.4f")
+    np.savetxt(tmp_path / "d.tsv.query", [12, 8], fmt="%d")
+    out = load_text_file(str(f))
+    assert out["X"].shape == (20, 3)
+    assert out["weight"].shape == (20,)
+    np.testing.assert_array_equal(out["group"], [12, 8])
+
+
+def test_cli_train_and_predict_match_api(tmp_path):
+    rs = np.random.RandomState(5)
+    X = rs.randn(500, 6)
+    w = rs.randn(6)
+    y = ((X @ w + 0.3 * rs.randn(500)) > 0).astype(float)
+    np.savetxt(tmp_path / "train.tsv", np.column_stack([y, X]),
+               delimiter="\t", fmt="%.6f")
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "task = train\nobjective = binary\ndata = train.tsv\n"
+        "num_trees = 10\nnum_leaves = 15\nmetric = auc\n"
+        "output_model = model.txt\nverbosity = -1\n"
+    )
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        assert cli_main([f"config={conf}"]) == 0
+        assert (tmp_path / "model.txt").exists()
+        assert cli_main([
+            "task=predict", "data=train.tsv", "input_model=model.txt",
+            "output_result=pred.txt",
+        ]) == 0
+    finally:
+        os.chdir(cwd)
+    pred_cli = np.loadtxt(tmp_path / "pred.txt")
+    bst = lgb.Booster(model_file=tmp_path / "model.txt")
+    np.testing.assert_allclose(pred_cli, bst.predict(X), rtol=1e-6, atol=1e-9)
+    from sklearn.metrics import roc_auc_score
+
+    assert roc_auc_score(y, pred_cli) > 0.8
+
+
+@pytest.mark.skipif(
+    not (REF / "examples" / "binary_classification" / "train.conf").exists(),
+    reason="reference examples unavailable",
+)
+def test_reference_example_conf_runs_unmodified(tmp_path):
+    ex = REF / "examples" / "binary_classification"
+    for f in ("binary.train", "binary.test", "train.conf"):
+        (tmp_path / f).write_bytes((ex / f).read_bytes())
+    # sidecar weight files like the reference example layout
+    (tmp_path / "binary.train.weight").write_bytes(
+        (ex / "binary.train.weight").read_bytes()
+    )
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = cli_main(["config=train.conf", "num_trees=5",
+                       "is_training_metric=false"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 0
+    assert (tmp_path / "LightGBM_model.txt").exists()
+    bst = lgb.Booster(model_file=tmp_path / "LightGBM_model.txt")
+    assert bst.num_trees() == 5
+
+
+def test_binary_cache_roundtrip(tmp_path):
+    rs = np.random.RandomState(7)
+    X = np.column_stack([rs.randint(0, 10, 300), rs.randn(300, 4)])
+    y = rs.randn(300)
+    ds = lgb.Dataset(X, label=y, weight=rs.rand(300),
+                     categorical_feature=[0], free_raw_data=False)
+    ds.construct()
+    path = str(tmp_path / "data.bin")
+    save_binary(ds._binned, path)
+    assert is_binary_file(path)
+    assert not is_binary_file(__file__)
+    b2 = load_binary(path)
+    np.testing.assert_array_equal(b2.bins, ds._binned.bins)
+    np.testing.assert_array_equal(b2.metadata.label, ds._binned.metadata.label)
+    np.testing.assert_array_equal(b2.metadata.weight, ds._binned.metadata.weight)
+    assert b2.num_data == 300
+    assert [m.num_bin for m in b2.mappers] == [m.num_bin for m in ds._binned.mappers]
+    assert b2.mappers[0].categories == ds._binned.mappers[0].categories
+
+    # training from the cache matches training from raw data
+    p = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+    b_raw = lgb.train(dict(p), ds, num_boost_round=5)
+    ds2 = lgb.Dataset.from_binned(b2)
+    b_cache = lgb.train(dict(p), ds2, num_boost_round=5)
+    np.testing.assert_allclose(
+        b_cache.predict(X[:50]), b_raw.predict(X[:50]), rtol=1e-6
+    )
