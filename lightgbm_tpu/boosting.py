@@ -548,7 +548,10 @@ class GBDT:
             eval_sets.append(
                 (
                     ss.name,
-                    DeviceEvalSet(c, names, hb, label, weight, dev["valid"], K),
+                    DeviceEvalSet(
+                        c, names, hb, label, weight, dev["valid"], K,
+                        group=meta.group,
+                    ),
                 )
             )
         self._f_eval_sets = eval_sets

@@ -237,6 +237,7 @@ class DeviceEvalSet:
         weight,
         valid,
         num_class: int,
+        group=None,
     ):
         import jax.numpy as jnp
 
@@ -244,8 +245,14 @@ class DeviceEvalSet:
         self.higher_better = higher_better
         w = _weights(weight, valid)
         fns = []
+        ndcg_factory = None
         for nm in metric_names:
             base = nm.split("@")[0]  # display names may carry "@k"
+            if base == "ndcg":
+                if ndcg_factory is None:
+                    ndcg_factory = _make_ndcg_factory(cfg, label, group)
+                fns.append((ndcg_factory(int(nm.split("@")[1])), False))
+                continue
             if num_class > 1 and base in ("multi_logloss", "multi_error"):
                 fns.append((_make_multiclass(base, cfg, label, w, num_class), True))
                 continue
@@ -268,20 +275,60 @@ class DeviceEvalSet:
         return jnp.stack(vals) if vals else jnp.zeros(0, jnp.float32)
 
 
+def _make_ndcg_factory(cfg: Config, label, group):
+    """Shared (Q, M) layout for all ndcg@k fns of one dataset; the per-k
+    sorts trace into the same step, so XLA CSEs them."""
+    import jax.numpy as jnp
+
+    from .learner.ranking import (
+        build_query_layout,
+        check_label_range,
+        default_label_gain,
+        ndcg_at,
+    )
+
+    npad = int(label.shape[0])
+    layout = build_query_layout(np.asarray(group), npad)
+    gains = list(cfg.label_gain)
+    if not gains:
+        gains = list(default_label_gain(int(np.asarray(label).max())))
+    check_label_range(np.asarray(label), len(gains))
+    gain_dev = jnp.asarray(np.asarray(gains), jnp.float32)
+    label_dev = jnp.asarray(label, jnp.float32)
+
+    def factory(k: int):
+        def f(s):
+            return ndcg_at(layout, s, label_dev, gain_dev, [k])[0]
+
+        return f
+
+    return factory
+
+
 # metric names the device path supports (superset check happens at build)
 def supported_names(metric_objs) -> Optional[Tuple[List[str], List[bool]]]:
-    """Map host Metric objects -> (names, higher_better) if all are
-    device-implementable, else None."""
+    """Map host Metric objects -> (display names, higher_better) if all
+    are device-implementable, else None. Multi-valued metrics (ndcg@k
+    per eval_at entry) expand to one display name per value, matching
+    the host metric's eval() tuples."""
     names, hb = [], []
     _ok = {
         "l2", "rmse", "l1", "quantile", "huber", "fair", "poisson", "mape",
         "gamma", "gamma_deviance", "tweedie", "binary_logloss",
         "binary_error", "cross_entropy", "auc", "multi_logloss",
-        "multi_error",
+        "multi_error", "ndcg",
     }
     for m in metric_objs:
         if m.name not in _ok:
             return None
+        if m.name == "ndcg":
+            if getattr(m, "group", None) is None:
+                return None
+            ks = list(m.config.eval_at) or [1, 2, 3, 4, 5]
+            for k in ks:
+                names.append(f"ndcg@{k}")
+                hb.append(True)
+            continue
         display = m.name
         if m.name == "multi_error":
             k = getattr(m.config, "multi_error_top_k", 1)

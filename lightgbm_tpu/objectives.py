@@ -381,98 +381,63 @@ class CrossEntropy(ObjectiveFunction):
 
 # ---------------------------------------------------------------- ranking
 class LambdaRank(ObjectiveFunction):
-    """reference rank_objective.hpp LambdarankNDCG.
+    """reference rank_objective.hpp LambdarankNDCG, device-resident.
 
-    Gradient computation runs on host (numpy) per iteration for now:
-    query groups are variable-sized and small; the padded segment-ops
-    device version is a later milestone.
+    Per-query sorting, pairwise delta-NDCG lambdas, truncation level and
+    norm all run on device via the padded (Q, M) query layout
+    (learner/ranking.py) — one traced function, fused-loop eligible,
+    vs the reference's per-query OpenMP loop (rank_objective.hpp:63-92).
     """
 
     name = "lambdarank"
     is_ranking = True
-    is_device_gradients = False
+    is_device_gradients = True
 
     def init(self, dataset):
         super().init(dataset)
         if self._meta.group is None:
             log.fatal("lambdarank requires query group information")
-        self._qb = self._meta.query_boundaries()
+        from .learner.ranking import (
+            build_query_layout,
+            check_label_range,
+            default_label_gain,
+            inverse_max_dcg,
+            lambdarank_gradients,
+        )
+
         label = np.asarray(self._meta.label)
+        npad = len(np.asarray(self.label))
+        self._layout = build_query_layout(self._meta.group, npad)
         gains = list(self.config.label_gain)
         if not gains:
-            max_label = int(label.max())
-            gains = [(1 << i) - 1 for i in range(max_label + 1)]
+            gains = list(default_label_gain(int(label.max())))
+        check_label_range(label, len(gains))
         self._label_gain = np.asarray(gains, dtype=np.float64)
         self._trunc = int(self.config.lambdarank_truncation_level)
         self._norm = bool(self.config.lambdarank_norm)
         self._sigmoid = float(self.config.sigmoid)
-        # inverse max DCG per query at truncation level
-        self._inv_max_dcg = np.zeros(len(self._qb) - 1)
-        for q in range(len(self._qb) - 1):
-            lab = label[self._qb[q]: self._qb[q + 1]].astype(int)
-            srt = np.sort(lab)[::-1][: self._trunc]
-            dcg = np.sum(self._label_gain[srt] / np.log2(np.arange(len(srt)) + 2))
-            self._inv_max_dcg[q] = 1.0 / dcg if dcg > 0 else 0.0
-        self._npad = len(np.asarray(self.label))
+        if self._sigmoid <= 0:
+            log.fatal(f"Sigmoid param {self._sigmoid} should be greater than zero")
+        imd = inverse_max_dcg(label, self._layout, self._label_gain, self._trunc)
+
+        label_dev = jnp.asarray(self.label, jnp.float32)
+        gain_dev = jnp.asarray(self._label_gain, jnp.float32)
+        imd_dev = jnp.asarray(imd, jnp.float32)
+        layout = self._layout
+        sig, trunc, norm = self._sigmoid, self._trunc, self._norm
+
+        def _grads(score):
+            g, h = lambdarank_gradients(
+                layout, score, label_dev, gain_dev, imd_dev, sig, trunc, norm
+            )
+            # tiny hessian floor keeps leaf outputs finite on degenerate
+            # queries (all-equal labels contribute zero hessian)
+            return g, jnp.maximum(h, 2e-7)
+
+        self._grads = _grads
 
     def get_gradients(self, score):
-        s = np.asarray(score)[: self._num_data].astype(np.float64)
-        label = np.asarray(self._meta.label).astype(int)
-        g = np.zeros(self._num_data)
-        h = np.zeros(self._num_data)
-        lg = self._label_gain
-        sig = self._sigmoid
-        for q in range(len(self._qb) - 1):
-            lo, hi = self._qb[q], self._qb[q + 1]
-            cnt = hi - lo
-            if cnt <= 1 or self._inv_max_dcg[q] == 0:
-                continue
-            sq = s[lo:hi]
-            lq = label[lo:hi]
-            order = np.argsort(-sq, kind="stable")
-            k = min(self._trunc, cnt)
-            # position discount by sorted rank (rank_objective.hpp:150-230):
-            # pairs (rank i < truncation) x (rank j > i), labels differ.
-            disc = 1.0 / np.log2(np.arange(cnt) + 2.0)
-            gain = lg[lq]
-            gi = np.zeros(cnt)
-            hi_ = np.zeros(cnt)
-            sum_lambdas = 0.0
-            for pi in range(k):
-                i = order[pi]
-                js = order[pi + 1:]
-                if len(js) == 0:
-                    break
-                dl = lq[i] - lq[js]
-                mask = dl != 0
-                if not np.any(mask):
-                    continue
-                high_is_i = dl > 0
-                ds = np.where(high_is_i, sq[i] - sq[js], sq[js] - sq[i])
-                dndcg = (
-                    np.abs((gain[i] - gain[js]) * (disc[pi] - disc[pi + 1:]))
-                    * self._inv_max_dcg[q]
-                )
-                p = 1.0 / (1.0 + np.exp(sig * ds))  # P(low beats high)
-                lam = sig * p * dndcg * mask
-                hess = sig * sig * p * (1.0 - p) * dndcg * mask
-                # push the high-labeled doc up (negative gradient), low down
-                gi[i] += np.sum(np.where(high_is_i, -lam, lam))
-                np.add.at(gi, js, np.where(high_is_i, lam, -lam))
-                hi_[i] += np.sum(hess)
-                np.add.at(hi_, js, hess)
-                sum_lambdas += 2.0 * np.sum(lam)
-            if self._norm and sum_lambdas > 0:
-                scale = np.log2(1.0 + sum_lambdas) / sum_lambdas
-                gi *= scale
-                hi_ *= scale
-            g[lo:hi] = gi
-            h[lo:hi] = hi_
-        gp = np.zeros(self._npad, np.float32)
-        hp = np.zeros(self._npad, np.float32)
-        gp[: self._num_data] = g
-        hp[: self._num_data] = np.maximum(h, 2e-7)
-        return jnp.asarray(gp), jnp.asarray(hp)
+        return self._grads(score)
 
     def convert_output(self, score):
         return score
