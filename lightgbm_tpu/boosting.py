@@ -169,6 +169,37 @@ class GBDT:
                 )
 
     # ------------------------------------------------------------------
+    def _renewal_setup(self):
+        """(alpha, weights) for device percentile leaf renewal, or
+        (None, None) when the objective doesn't renew. MAPE renews with
+        its label-derived weights (regression_objective.hpp:641)."""
+        import jax.numpy as jnp
+
+        o = self.objective
+        if o is None or not o.is_renew_tree_output:
+            return None, None
+        alpha = float(o.renew_percentile())
+        w = getattr(o, "_label_weight", None)
+        if w is None:
+            w = o.weight
+        if w is None:
+            w = jnp.ones(self.train_set.num_rows_padded(), jnp.float32)
+        return alpha, w
+
+    def _apply_renewal(self, arrays, row_leaf, score_k, mask, renew_alpha,
+                       renew_w):
+        """Device percentile leaf refit (shared by fast + fused paths)."""
+        from .learner.renewal import renew_leaf_values
+
+        resid = self._label_dev - score_k
+        return arrays._replace(
+            leaf_value=renew_leaf_values(
+                arrays.leaf_value, row_leaf, resid, renew_w * mask,
+                renew_alpha, self.spec.num_leaves,
+            )
+        )
+
+    # ------------------------------------------------------------------
     def _grow(self, gk, hk, mask, feat_mask, valid):
         """Grow one tree on the training set — serial, or sharded over the
         data mesh when tree_learner=data/voting (lockstep trees on every
@@ -309,12 +340,13 @@ class GBDT:
         (no splittable leaf), matching GBDT::TrainOneIter (gbdt.cpp:352)."""
         if self._stopped:
             return True
-        # leaf-renewal objectives (l1/huber/quantile/mape) need per-iter
-        # host work even under a custom fobj (the reference's
-        # UpdateOneIterCustom still calls RenewTreeOutput)
+        # leaf renewal runs on device (learner/renewal.py), so renewal
+        # objectives ride the fast path too; custom fobj with a renewal
+        # objective still renews (the reference's UpdateOneIterCustom
+        # calls RenewTreeOutput as well)
         fast = not self._force_sync and (
-            self.objective is None or not self.objective.is_renew_tree_output
-        ) and (grad is not None or self.objective is not None)
+            grad is not None or self.objective is not None
+        )
         if fast:
             return self._train_one_iter_fast(grad, hess)
         return self._train_one_iter_sync(grad, hess)
@@ -371,6 +403,7 @@ class GBDT:
 
         K = self.num_class
         grad_dev, hess_dev, init_scores = self._prepare_gradients(grad, hess)
+        renew_alpha, renew_w = self._renewal_setup()
 
         one = jnp.float32(1.0)
         for k in range(K):
@@ -381,6 +414,11 @@ class GBDT:
             feat_mask = self._sample_features(k=k)
             arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, self.dev["valid"])
             ok = (arrays.num_nodes > 0).astype(jnp.float32)
+            if renew_alpha is not None:
+                arrays = self._apply_renewal(
+                    arrays, row_leaf, self.train.score[k], mask,
+                    renew_alpha, renew_w,
+                )
             lv = arrays.leaf_value * (self.shrinkage_rate * ok)
             # score updates use the UNBIASED shrunk leaf values — the
             # score already received init_scores[k] at BoostFromAverage
@@ -509,8 +547,6 @@ class GBDT:
     def fused_eligible(self) -> bool:
         if self._force_sync or self.objective is None:
             return False
-        if self.objective.is_renew_tree_output:
-            return False
         if not getattr(self.objective, "is_device_gradients", True):
             return False
         from .device_metrics import supported_names
@@ -564,6 +600,7 @@ class GBDT:
         strategy = self.strategy
         dev = self.dev
         traverse = traverse_tree_bins
+        renew_alpha, renew_w = self._renewal_setup()
         label_dev = self._label_dev
         track_train_eval = track_train
 
@@ -590,6 +627,12 @@ class GBDT:
                     feat_mask = jnp.ones(F, dtype=bool)
                 arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, dev["valid"])
                 ok = (arrays.num_nodes > 0).astype(jnp.float32)
+                if renew_alpha is not None:
+                    # percentile leaf refit on device (RenewTreeOutput,
+                    # gbdt.cpp:418 — before shrinkage, in-bag rows only)
+                    arrays = self._apply_renewal(
+                        arrays, row_leaf, score[k], mask, renew_alpha, renew_w
+                    )
                 lv = arrays.leaf_value * (shrink * ok)
                 one = jnp.float32(1.0)
                 score = score.at[k].set(add_score(score[k], row_leaf, lv, one))

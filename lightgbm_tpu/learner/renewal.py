@@ -1,0 +1,64 @@
+"""Device-resident percentile leaf renewal for l1/huber/quantile/mape.
+
+The reference refits each leaf's output to a weighted percentile of the
+residuals of its in-bag rows (RegressionL1loss::RenewTreeOutput,
+regression_objective.hpp:251; gbdt.cpp:418 RenewTreeOutput before
+shrinkage). The host implementation loops leaves with numpy sorts; this
+is the traced equivalent so renewal objectives can ride the fused
+one-dispatch-per-iteration loop:
+
+one `lax.sort` by (leaf, residual) groups every leaf's rows contiguously
+in residual order; per-leaf cumulative weights come from the same
+masked-fill trick as the device AUC; the percentile element is the first
+row of each group whose in-group cumulative weight reaches
+alpha * (group total), scattered back by leaf id.
+"""
+
+from __future__ import annotations
+
+
+def renew_leaf_values(leaf_value, row_leaf, resid, w, alpha, num_leaves: int):
+    """Weighted-percentile residual per leaf (traced).
+
+    leaf_value: (L,) current outputs (kept where a leaf has no rows)
+    row_leaf:   (N,) int32 leaf id per row; negative = not in any leaf
+    resid:      (N,) f32 residuals (label - score)
+    w:          (N,) f32 weights; 0 excludes a row (padding / out-of-bag)
+    alpha:      percentile in [0, 1] (0.5 = median)
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    N = row_leaf.shape[0]
+    L = num_leaves
+    incl = (w > 0) & (row_leaf >= 0)
+    key_leaf = jnp.where(incl, row_leaf, L).astype(jnp.int32)
+    sk, sr, sw = lax.sort(
+        (key_leaf, resid.astype(jnp.float32), jnp.where(incl, w, 0.0)),
+        num_keys=2,
+    )
+    start = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+    end = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones(1, bool)])
+
+    # SEGMENTED inclusive cumsum: weight sums reset at each leaf group, so
+    # magnitudes stay ~(leaf weight) instead of ~(total weight) — a global
+    # f32 cumsum would stop resolving unit weights past 2^24 rows (the
+    # host/reference equivalent accumulates per leaf in f64)
+    def seg_op(a, b):
+        fa, sa = a
+        fb, sb = b
+        return fa | fb, jnp.where(fb, sb, sa + sb)
+
+    _, seg_cumw = lax.associative_scan(seg_op, (start, sw))
+    # per-leaf total weight by direct segment-sum (pad group dropped)
+    gtot_leaf = jnp.zeros(L, jnp.float32).at[sk].add(sw, mode="drop")
+    gtotal = jnp.where(sk < L, gtot_leaf[jnp.minimum(sk, L - 1)], jnp.inf)
+    # group end always counts as reached: the reference clamps the
+    # percentile index to the last row (idx = min(searchsorted, len-1)),
+    # and scan-vs-scatter rounding could otherwise leave alpha=1 unmet
+    reached = (seg_cumw >= alpha * gtotal) | (end & (sk < L))
+    reached_prev = jnp.concatenate([jnp.zeros(1, bool), reached[:-1]])
+    first = reached & (start | ~reached_prev)
+    # scatter: at most one `first` per leaf group; drop the pad group (L)
+    idx = jnp.where(first & (sk < L), sk, L)
+    return leaf_value.at[idx].set(sr, mode="drop")

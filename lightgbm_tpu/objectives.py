@@ -426,15 +426,25 @@ class LambdaRank(ObjectiveFunction):
         layout = self._layout
         sig, trunc, norm = self._sigmoid, self._trunc, self._norm
 
+        weight_dev = self.weight
+
         def _grads(score):
             g, h = lambdarank_gradients(
                 layout, score, label_dev, gain_dev, imd_dev, sig, trunc, norm
             )
+            # per-document weights (RankingObjective::GetGradients
+            # rank_objective.hpp:84-90 multiplies lambdas and hessians)
+            if weight_dev is not None:
+                g = g * weight_dev
+                h = h * weight_dev
             # tiny hessian floor keeps leaf outputs finite on degenerate
             # queries (all-equal labels contribute zero hessian)
             return g, jnp.maximum(h, 2e-7)
 
-        self._grads = _grads
+        # jitted: non-fused callers run this eagerly every iteration —
+        # tracing once embeds the (Q, M) layout as a device constant
+        # instead of re-uploading it per call
+        self._grads = jax.jit(_grads)
 
     def get_gradients(self, score):
         return self._grads(score)

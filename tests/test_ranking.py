@@ -159,7 +159,44 @@ def test_lambdarank_end_to_end_fused():
     assert after > before + 0.15, (before, after)
 
 
-def test_lambdarank_weighted_and_sklearn():
+def test_lambdarank_document_weights_scale_gradients():
+    """RankingObjective::GetGradients multiplies lambdas/hessians by the
+    per-document weights (rank_objective.hpp:84-90)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objectives import create_objective
+
+    X, y, group = _rank_problem(nq=10, seed=5)
+    rs = np.random.RandomState(6)
+    w = 0.5 + rs.rand(len(y))
+
+    def grads(weight):
+        ds = lgb.Dataset(X, label=y, group=group, weight=weight,
+                         free_raw_data=False)
+        ds.construct()
+        obj = create_objective(Config({"objective": "lambdarank"}))
+        obj.init(ds._binned)
+        npad = ds._binned.num_rows_padded()
+        import jax.numpy as jnp
+
+        return obj.get_gradients(jnp.zeros(npad, jnp.float32))
+
+    g0, h0 = grads(None)
+    gw, hw = grads(w)
+    n = len(y)
+    wp = np.zeros(np.asarray(g0).shape)
+    wp[:n] = w
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(g0) * wp,
+                               rtol=1e-5, atol=1e-7)
+    # hessians: compare where the pre-floor value dominates (docs in no
+    # pair sit at the 2e-7 floor in both runs regardless of weight)
+    h0n, hwn = np.asarray(h0)[:n], np.asarray(hw)[:n]
+    live = h0n > 1e-6
+    assert live.any()
+    np.testing.assert_allclose(hwn[live], h0n[live] * w[live],
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_lambdarank_sklearn():
     X, y, group = _rank_problem(seed=9)
     rk = lgb.LGBMRanker(n_estimators=8, num_leaves=7, verbosity=-1,
                         min_data_in_leaf=5)

@@ -1,0 +1,97 @@
+"""Device percentile leaf renewal (learner/renewal.py) must match the
+host numpy renewal (sync path) — l1/huber/quantile/mape now ride the
+fast and fused loops (RenewTreeOutput, regression_objective.hpp:251)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _problem(n=2000, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 6)
+    w = rs.randn(6)
+    y = X @ w + 0.5 * rs.standard_cauchy(n)  # heavy tails: renewal matters
+    return X, y
+
+
+def _train(params, X, y, sync: bool, rounds=10, weight=None):
+    ds = lgb.Dataset(X, label=y, weight=weight, free_raw_data=False)
+    bst = lgb.Booster(params=dict(params), train_set=ds)
+    if sync:
+        bst._gbdt._force_sync = True
+    for _ in range(rounds):
+        bst.update()
+    bst._gbdt._materialize()
+    return bst
+
+
+@pytest.mark.parametrize("objective", ["regression_l1", "quantile", "mape", "huber"])
+def test_device_renewal_matches_host(objective):
+    X, y = _problem()
+    params = {
+        "objective": objective,
+        "num_leaves": 15,
+        "learning_rate": 0.2,
+        "verbosity": -1,
+        "min_data_in_leaf": 10,
+    }
+    b_sync = _train(params, X, y, sync=True)
+    b_fast = _train(params, X, y, sync=False)
+    np.testing.assert_allclose(
+        b_fast.predict(X[:200]), b_sync.predict(X[:200]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_device_renewal_weighted():
+    X, y = _problem(seed=3)
+    rs = np.random.RandomState(4)
+    weight = 0.2 + rs.rand(len(y))
+    params = {
+        "objective": "quantile",
+        "alpha": 0.7,
+        "num_leaves": 7,
+        "verbosity": -1,
+    }
+    b_sync = _train(params, X, y, sync=True, weight=weight)
+    b_fast = _train(params, X, y, sync=False, weight=weight)
+    np.testing.assert_allclose(
+        b_fast.predict(X[:200]), b_sync.predict(X[:200]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_l1_rides_fused_loop_and_learns():
+    X, y = _problem(seed=7)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = {
+        "objective": "regression_l1",
+        "metric": "l1",
+        "num_leaves": 15,
+        "learning_rate": 0.3,
+        "verbosity": -1,
+    }
+    bst = lgb.train(dict(params), ds, num_boost_round=30,
+                    valid_sets=[ds], valid_names=["t"])
+    assert bst._gbdt.fused_eligible()
+    mae0 = np.mean(np.abs(y - np.median(y)))
+    mae = np.mean(np.abs(y - bst.predict(X)))
+    assert mae < 0.7 * mae0, (mae, mae0)
+
+
+def test_renewal_with_bagging_matches():
+    X, y = _problem(seed=11)
+    params = {
+        "objective": "regression_l1",
+        "num_leaves": 7,
+        "bagging_fraction": 0.7,
+        "bagging_freq": 1,
+        "verbosity": -1,
+    }
+    b_sync = _train(params, X, y, sync=True, rounds=8)
+    b_fast = _train(params, X, y, sync=False, rounds=8)
+    np.testing.assert_allclose(
+        b_fast.predict(X[:100]), b_sync.predict(X[:100]), rtol=1e-5, atol=1e-6
+    )
