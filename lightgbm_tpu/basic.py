@@ -324,6 +324,47 @@ class Booster:
         self._name_valid_sets.append(name)
         return self
 
+    def _continue_from(self, init_booster: "Booster") -> None:
+        """Continued training (reference input_model / python init_model,
+        boosting.h:311): adopt the loaded model's trees and seed every
+        score set with their binned-traversal predictions, then keep
+        appending trees. Call after add_valid."""
+        from .tree import tree_to_arrays
+
+        from . import log
+
+        gb = self._gbdt
+        src = init_booster._gbdt
+        K = gb.num_class
+        if src.num_class != K:
+            log.fatal(
+                f"init_model has {src.num_class} models per iteration, "
+                f"training config has {K}"
+            )
+        if gb.config.boosting in ("dart", "rf"):
+            # DART drop bookkeeping and RF's running-average score have
+            # no stored state for the loaded trees — refuse rather than
+            # silently corrupt (reference keeps full state in-process)
+            log.fatal(
+                f"init_model with boosting={gb.config.boosting} is not "
+                "supported yet; use boosting=gbdt for continued training"
+            )
+        models = list(src.models)
+        gb._models = list(models)
+        gb.iter_ = len(models) // K
+        gb._init_iters = gb.iter_  # iteration origin for truncate/snapshot
+        for mi, t in enumerate(models):
+            arrays = tree_to_arrays(t, gb.train_set)
+            gb.device_trees.append((arrays, None))
+            k = mi % K
+            for ss in [gb.train] + gb.valids:
+                dev = gb.dev if ss is gb.train else ss.dataset.device_arrays()
+                if t.num_leaves > 1:
+                    leaf = gb._traverse(arrays, dev["bins"], dev["nan_bin"])
+                    ss.score = ss.score.at[k].add(arrays.leaf_value[leaf])
+                else:
+                    ss.score = ss.score.at[k].add(float(t.leaf_value[0]))
+
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration (basic.py:4052). Returns True if
         training stopped (cannot split any more)."""

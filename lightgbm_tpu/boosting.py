@@ -79,9 +79,14 @@ class GBDT:
         self._stopped = False
         self._check_every = 50
         self._force_sync = False
+        self._init_iters = 0  # loaded iterations under continued training
 
         if train_set is None:
             return  # prediction-only booster (model loaded from file)
+
+        from .config import warn_unimplemented
+
+        warn_unimplemented(config)
 
         # ---- tree learner selection (reference tree_learner.cpp:17-59):
         # "data"/"voting" route growth through the sharded grower over a

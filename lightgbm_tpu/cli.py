@@ -157,9 +157,11 @@ def _task_train(params: Dict[str, str]) -> None:
         valid_names = ["training"] + valid_names
 
     num_rounds = cfg.num_iterations
+    init_model = cfg.input_model  # resolves model_in/model_input aliases
     booster = lgb_train(
         dict(params), ds, num_boost_round=num_rounds,
         valid_sets=valid_sets, valid_names=valid_names,
+        init_model=init_model or None,
     )
     out = params.get("output_model", "LightGBM_model.txt")
     booster.save_model(out)

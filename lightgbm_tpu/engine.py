@@ -56,9 +56,6 @@ def train(
     ):
         callbacks.append(callback_mod.log_evaluation(period=cfg_probe.metric_freq))
 
-    if init_model is not None:
-        raise NotImplementedError("continued training (init_model) is a later milestone")
-
     booster = Booster(params=params, train_set=train_set)
     valid_sets = valid_sets or []
     valid_names = valid_names or []
@@ -71,10 +68,31 @@ def train(
             continue
         booster.add_valid(vs, name)
 
+    if init_model is not None:
+        ib = (
+            init_model
+            if isinstance(init_model, Booster)
+            else Booster(model_file=init_model)
+        )
+        booster._continue_from(ib)
+
     cb_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
     cb_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
     cb_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cb_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    snapshot_freq = cfg_probe.snapshot_freq
+
+    def _snapshot(done_iter: int) -> None:
+        """snapshot_freq model dumps during training (gbdt.cpp:258-262)."""
+        if snapshot_freq > 0 and (done_iter + 1) % snapshot_freq == 0:
+            out = f"{cfg_probe.output_model}.snapshot_iter_{done_iter + 1}"
+            # clamp explicitly (the fused path materializes whole chunks
+            # before callbacks replay); done_iter counts NEW iterations —
+            # offset by any init_model trees so snapshots keep them
+            total = booster._gbdt._init_iters + done_iter + 1
+            booster.save_model(out, num_iteration=total)
+            log.info(f"Saved snapshot to {out}")
 
     evaluation_result_list: List[Tuple] = []
     i = -1
@@ -102,13 +120,15 @@ def train(
             for j, evals in enumerate(records):
                 i = done + j
                 evaluation_result_list = evals
+                _snapshot(i)
                 try:
                     for cb in cb_after:
                         cb(CallbackEnv(booster, params, i, 0, num_boost_round, evals))
                 except EarlyStopException as e:
                     booster.best_iteration = e.best_iteration + 1
                     evaluation_result_list = e.best_score
-                    gbdt.fused_truncate(i + 1)
+                    # truncate counts TOTAL iterations: keep loaded trees
+                    gbdt.fused_truncate(gbdt._init_iters + i + 1)
                     stop = True
                     break
             done += max(len(records), 1)
@@ -136,6 +156,7 @@ def train(
                 evaluation_result_list.extend(booster.eval_train(feval))
             if booster._gbdt.valids:
                 evaluation_result_list.extend(booster.eval_valid(feval))
+            _snapshot(i)
             try:
                 for cb in cb_after:
                     cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))

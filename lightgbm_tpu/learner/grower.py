@@ -41,7 +41,7 @@ from .histogram import (
     histogram,
     root_sums,
 )
-from .split import NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
+from .split import BIG, NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
 
 
 class GrowerSpec(NamedTuple):
@@ -99,6 +99,8 @@ class _State(NamedTuple):
     leaf_h: jax.Array
     leaf_c: jax.Array
     leaf_parent: jax.Array
+    leaf_min: jax.Array  # (L,) monotone-constraint interval per leaf
+    leaf_max: jax.Array
     best: SplitRecord  # per-leaf arrays (L,)
     tree: TreeArrays
 
@@ -123,10 +125,11 @@ def make_split_params(cfg) -> SplitParams:
 
 
 def split_leaf_outputs(rec: SplitRecord, params: SplitParams, num_bins,
-                       use_cat_subset: bool):
-    """Left/right child outputs for a chosen split. Sorted-subset
-    categorical splits regularize with l2 + cat_l2
-    (feature_histogram.cpp:251,346); one-hot and numerical use l2."""
+                       use_cat_subset: bool, parent_output, cmin, cmax):
+    """Left/right child outputs for a chosen split: path smoothing toward
+    the parent output, clamped to the PARENT's monotone interval
+    (BasicLeafConstraints clone-then-update). Sorted-subset categorical
+    splits regularize with l2 + cat_l2 (feature_histogram.cpp:251,346)."""
     if use_cat_subset:
         is_sub = rec.is_cat & (num_bins[rec.feature] > params.max_cat_to_onehot)
         p = params._replace(
@@ -134,9 +137,26 @@ def split_leaf_outputs(rec: SplitRecord, params: SplitParams, num_bins,
         )
     else:
         p = params
-    return leaf_output(rec.left_g, rec.left_h, p), leaf_output(
-        rec.right_g, rec.right_h, p
-    )
+    lo = leaf_output(rec.left_g, rec.left_h, p, rec.left_c, parent_output,
+                     cmin, cmax)
+    ro = leaf_output(rec.right_g, rec.right_h, p, rec.right_c, parent_output,
+                     cmin, cmax)
+    return lo, ro
+
+
+def monotone_child_intervals(rec: SplitRecord, mono, lo, ro, cur_min, cur_max):
+    """BasicLeafConstraints::Update (monotone_constraints.hpp:489): a
+    NUMERICAL split on a monotone feature tightens the children's output
+    intervals around mid = (lo + ro) / 2; both children inherit the
+    parent interval otherwise."""
+    m = mono[rec.feature]
+    upd = (~rec.is_cat) & (m != 0)
+    mid = (lo + ro) / 2.0
+    lmin = jnp.where(upd & (m < 0), jnp.maximum(cur_min, mid), cur_min)
+    lmax = jnp.where(upd & (m > 0), jnp.minimum(cur_max, mid), cur_max)
+    rmin = jnp.where(upd & (m > 0), jnp.maximum(cur_min, mid), cur_min)
+    rmax = jnp.where(upd & (m < 0), jnp.minimum(cur_max, mid), cur_max)
+    return lmin, lmax, rmin, rmax
 
 
 def _empty_best(L: int, B: int) -> SplitRecord:
@@ -239,7 +259,10 @@ def _grow_tree_flat(
     hist0 = histogram(bins_fm, gh8, B)
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
-    rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin, mono, is_cat, params, feat_mask, cat_subset=spec.cat_subset)
+    root_out = leaf_output(root[0], root[1], params)
+    rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin,
+                      mono, is_cat, params, feat_mask,
+                      cat_subset=spec.cat_subset, parent_output=root_out)
 
     hist = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
     best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
@@ -276,6 +299,8 @@ def _grow_tree_flat(
         leaf_h=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
         leaf_c=jnp.zeros(L, jnp.float32).at[0].set(root[2]),
         leaf_parent=jnp.full(L, -1, jnp.int32),
+        leaf_min=jnp.full(L, -BIG, jnp.float32),
+        leaf_max=jnp.full(L, BIG, jnp.float32),
         best=best,
         tree=tree,
     )
@@ -303,7 +328,12 @@ def _grow_tree_flat(
         node_left = node_left.at[i].set(~l)
         node_right = node_right.at[i].set(~new)
 
-        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset)
+        pmin, pmax = s.leaf_min[l], s.leaf_max[l]
+        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset,
+                                    t.leaf_value[l], pmin, pmax)
+        lmin, lmax, rmin, rmax = monotone_child_intervals(
+            rec, mono, lo, ro, pmin, pmax
+        )
         depth_new = t.leaf_depth[l] + 1
 
         tree_new = TreeArrays(
@@ -391,10 +421,12 @@ def _grow_tree_flat(
         # ---- best splits for both children ----
         bl = best_split(left_hist, rec.left_g, rec.left_h, rec.left_c,
                         num_bins, nan_bin, mono, is_cat, params, feat_mask,
-                        cat_subset=spec.cat_subset)
+                        cat_subset=spec.cat_subset, parent_output=lo,
+                        cmin=lmin, cmax=lmax)
         br = best_split(right_hist, rec.right_g, rec.right_h, rec.right_c,
                         num_bins, nan_bin, mono, is_cat, params, feat_mask,
-                        cat_subset=spec.cat_subset)
+                        cat_subset=spec.cat_subset, parent_output=ro,
+                        cmin=rmin, cmax=rmax)
         depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
         best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
         best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))
@@ -407,6 +439,8 @@ def _grow_tree_flat(
             leaf_h=s.leaf_h.at[l].set(rec.left_h).at[new].set(rec.right_h),
             leaf_c=s.leaf_c.at[l].set(rec.left_c).at[new].set(rec.right_c),
             leaf_parent=s.leaf_parent.at[l].set(i).at[new].set(i),
+            leaf_min=s.leaf_min.at[l].set(lmin).at[new].set(rmin),
+            leaf_max=s.leaf_max.at[l].set(lmax).at[new].set(rmax),
             best=best2,
             tree=tree_new,
         )

@@ -37,13 +37,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import HIST_BLK, build_gh8, histogram, root_sums
-from .split import NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
+from .split import BIG, NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
 from .grower import (
     GrowerSpec,
     TreeArrays,
     _empty_best,
     _get_best,
     _set_best,
+    monotone_child_intervals,
     split_leaf_outputs,
 )
 
@@ -73,6 +74,8 @@ class _PState(NamedTuple):
     leaf_h: jax.Array
     leaf_c: jax.Array
     leaf_parent: jax.Array
+    leaf_min: jax.Array  # (L,) monotone-constraint interval per leaf
+    leaf_max: jax.Array
     best: SplitRecord
     tree: TreeArrays
 
@@ -113,9 +116,10 @@ def grow_tree_permuted(
     hist0 = histogram(bins_fm, gh8, B)
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
+    root_out = leaf_output(root[0], root[1], params)
     rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin,
                       mono, is_cat, params, feat_mask,
-                      cat_subset=spec.cat_subset)
+                      cat_subset=spec.cat_subset, parent_output=root_out)
 
     hist = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
     best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
@@ -154,6 +158,8 @@ def grow_tree_permuted(
         leaf_h=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
         leaf_c=jnp.zeros(L, jnp.float32).at[0].set(root[2]),
         leaf_parent=jnp.full(L, -1, jnp.int32),
+        leaf_min=jnp.full(L, -BIG, jnp.float32),
+        leaf_max=jnp.full(L, BIG, jnp.float32),
         best=best,
         tree=tree,
     )
@@ -181,7 +187,12 @@ def grow_tree_permuted(
         node_left = node_left.at[i].set(~l)
         node_right = node_right.at[i].set(~new)
 
-        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset)
+        pmin, pmax = s.leaf_min[l], s.leaf_max[l]
+        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset,
+                                    t.leaf_value[l], pmin, pmax)
+        lmin, lmax, rmin, rmax = monotone_child_intervals(
+            rec, mono, lo, ro, pmin, pmax
+        )
         depth_new = t.leaf_depth[l] + 1
 
         tree_new = TreeArrays(
@@ -289,10 +300,12 @@ def grow_tree_permuted(
         # ---- best splits for both children ----
         bl = best_split(left_hist, rec.left_g, rec.left_h, rec.left_c,
                         num_bins, nan_bin, mono, is_cat, params, feat_mask,
-                        cat_subset=spec.cat_subset)
+                        cat_subset=spec.cat_subset, parent_output=lo,
+                        cmin=lmin, cmax=lmax)
         br = best_split(right_hist, rec.right_g, rec.right_h, rec.right_c,
                         num_bins, nan_bin, mono, is_cat, params, feat_mask,
-                        cat_subset=spec.cat_subset)
+                        cat_subset=spec.cat_subset, parent_output=ro,
+                        cmin=rmin, cmax=rmax)
         depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
         best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
         best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))
@@ -309,6 +322,8 @@ def grow_tree_permuted(
             leaf_h=s.leaf_h.at[l].set(rec.left_h).at[new].set(rec.right_h),
             leaf_c=s.leaf_c.at[l].set(rec.left_c).at[new].set(rec.right_c),
             leaf_parent=s.leaf_parent.at[l].set(i).at[new].set(i),
+            leaf_min=s.leaf_min.at[l].set(lmin).at[new].set(rmin),
+            leaf_max=s.leaf_max.at[l].set(lmax).at[new].set(rmax),
             best=best2,
             tree=tree_new,
         )

@@ -76,28 +76,72 @@ def threshold_l1(s: jax.Array, l1: jax.Array) -> jax.Array:
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 
 
-def leaf_output(g: jax.Array, h: jax.Array, p: SplitParams) -> jax.Array:
-    """CalculateSplittedLeafOutput (no constraints): -T(G)/(H+l2), clipped
-    by max_delta_step when positive."""
+BIG = 1e29  # constraint sentinel (comfortably inside f32)
+
+
+def leaf_output(
+    g: jax.Array,
+    h: jax.Array,
+    p: SplitParams,
+    count: Optional[jax.Array] = None,
+    parent_output: Optional[jax.Array] = None,
+    cmin: Optional[jax.Array] = None,
+    cmax: Optional[jax.Array] = None,
+) -> jax.Array:
+    """CalculateSplittedLeafOutput (feature_histogram.hpp): -T(G)/(H+l2),
+    clipped by max_delta_step, then path smoothing
+    out*n/(n+ps) + parent*ps/(n+ps) when count/parent are given, then
+    clamped to the leaf's monotone-constraint interval [cmin, cmax]."""
     out = -threshold_l1(g, p.lambda_l1) / (h + p.lambda_l2 + K_EPSILON)
-    return jnp.where(
+    out = jnp.where(
         p.max_delta_step > 0.0,
         jnp.clip(out, -p.max_delta_step, p.max_delta_step),
         out,
     )
+    if count is not None and parent_output is not None:
+        denom = count + p.path_smooth
+        sm = (out * count + parent_output * p.path_smooth) / jnp.maximum(
+            denom, K_EPSILON
+        )
+        out = jnp.where(p.path_smooth > 0.0, sm, out)
+    if cmin is not None:
+        out = jnp.clip(out, cmin, cmax)
+    return out
 
 
-def leaf_gain(g: jax.Array, h: jax.Array, p: SplitParams) -> jax.Array:
-    """GetLeafGain: T(G)^2/(H+l2); with max_delta_step falls back to
-    GetLeafGainGivenOutput(-(2 T(G) o + (H+l2) o^2))."""
+def leaf_gain_given_output(g, h, p: SplitParams, output) -> jax.Array:
+    """GetLeafGainGivenOutput: -(2 T(G) o + (H+l2) o^2)."""
+    t = threshold_l1(g, p.lambda_l1)
+    return -(2.0 * t * output + (h + p.lambda_l2) * output * output)
+
+
+def leaf_gain(
+    g: jax.Array,
+    h: jax.Array,
+    p: SplitParams,
+    count: Optional[jax.Array] = None,
+    parent_output: Optional[jax.Array] = None,
+    cmin: Optional[jax.Array] = None,
+    cmax: Optional[jax.Array] = None,
+) -> jax.Array:
+    """GetLeafGain: the closed form T(G)^2/(H+l2) when no output
+    modifier is active; otherwise GetLeafGainGivenOutput at the
+    clipped/smoothed/clamped output (the reference's USE_MAX_OUTPUT /
+    USE_SMOOTHING / constraint template branches)."""
     t = threshold_l1(g, p.lambda_l1)
     free = t * t / (h + p.lambda_l2 + K_EPSILON)
-    o = leaf_output(g, h, p)
-    clipped = -(2.0 * t * o + (h + p.lambda_l2) * o * o)
-    return jnp.where(p.max_delta_step > 0.0, clipped, free)
+    o = leaf_output(g, h, p, count, parent_output, cmin, cmax)
+    given = leaf_gain_given_output(g, h, p, o)
+    active = p.max_delta_step > 0.0
+    if count is not None and parent_output is not None:
+        active = active | (p.path_smooth > 0.0)
+    if cmin is not None:
+        active = active | (cmin > -BIG) | (cmax < BIG)
+    return jnp.where(active, given, free)
 
 
-def _cat_subset_scan(g, h, c, num_bins, nan_bin, is_cat, sum_g, sum_h, sum_c, params):
+def _cat_subset_scan(g, h, c, num_bins, nan_bin, is_cat, sum_g, sum_h, sum_c,
+                     params, parent_output, cmin, cmax):
     """Sorted-subset categorical split search (feature_histogram.cpp:246+
     FindBestThresholdCategoricalInner, non-onehot branch), vectorized over
     features with the per-bin scan expressed as cumulative sums:
@@ -191,7 +235,9 @@ def _cat_subset_scan(g, h, c, num_bins, nan_bin, is_cat, sum_g, sum_h, sum_c, pa
     do_eval = jnp.moveaxis(do_eval, 0, 1)  # (F, B, 2)
 
     cat_params = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
-    gains = leaf_gain(lg, lh, cat_params) + leaf_gain(rg, rh, cat_params)
+    gains = leaf_gain(
+        lg, lh, cat_params, lc, parent_output, cmin, cmax
+    ) + leaf_gain(rg, rh, cat_params, rc, parent_output, cmin, cmax)
     ok = do_eval & pos_ok
     return gains, ok, jnp.stack([lg, lh, lc]), inv_rank, valid_bin, used
 
@@ -208,6 +254,9 @@ def best_split(
     params: SplitParams,
     feat_mask: Optional[jax.Array] = None,  # (F,) bool — ColSampler feature_fraction
     cat_subset: bool = False,  # static: dataset has large-cardinality cats
+    parent_output: jax.Array = 0.0,  # the leaf's current output (smoothing)
+    cmin: jax.Array = -BIG,  # monotone-constraint interval of the leaf
+    cmax: jax.Array = BIG,
 ) -> SplitRecord:
     """Find the best split of a leaf with given histogram and totals."""
     _, F, B = hist.shape
@@ -230,7 +279,9 @@ def best_split(
         rg = sum_g - lg
         rh = sum_h - lh
         rc = sum_c - lc
-        gains = leaf_gain(lg, lh, params) + leaf_gain(rg, rh, params)
+        gains = leaf_gain(
+            lg, lh, params, lc, parent_output, cmin, cmax
+        ) + leaf_gain(rg, rh, params, rc, parent_output, cmin, cmax)
         ok = (
             (lc >= params.min_data_in_leaf)
             & (rc >= params.min_data_in_leaf)
@@ -238,8 +289,8 @@ def best_split(
             & (rh >= params.min_sum_hessian_in_leaf)
         )
         # monotone basic: candidate-level output ordering
-        lo = leaf_output(lg, lh, params)
-        ro = leaf_output(rg, rh, params)
+        lo = leaf_output(lg, lh, params, lc, parent_output, cmin, cmax)
+        ro = leaf_output(rg, rh, params, rc, parent_output, cmin, cmax)
         m = mono[:, None]
         ok &= jnp.where(m > 0, lo <= ro, True)
         ok &= jnp.where(m < 0, lo >= ro, True)
@@ -273,7 +324,12 @@ def best_split(
     if cat_subset:
         ok_cat &= (num_bins <= params.max_cat_to_onehot)[:, None]
 
-    parent_gain = leaf_gain(sum_g, sum_h, params)
+    parent_gain_plain = leaf_gain(sum_g, sum_h, params)
+    parent_gain = jnp.where(
+        params.path_smooth > 0.0,
+        leaf_gain_given_output(sum_g, sum_h, params, parent_output),
+        parent_gain_plain,
+    )
     shift = parent_gain + params.min_gain_to_split
 
     # stack: dir axis LAST in flat order (F, B, D) so ties break on
@@ -287,7 +343,8 @@ def best_split(
     if cat_subset:
         big = is_cat & (num_bins > params.max_cat_to_onehot)
         cs_gain, cs_ok, cs_sums, inv_rank, valid_bin, cs_used = _cat_subset_scan(
-            g, h, c, num_bins, nan_bin, big, sum_g, sum_h, sum_c, params
+            g, h, c, num_bins, nan_bin, big, sum_g, sum_h, sum_c, params,
+            parent_output, cmin, cmax,
         )
         dirs += [cs_gain[:, :, 0], cs_gain[:, :, 1]]
         oks += [cs_ok[:, :, 0], cs_ok[:, :, 1]]

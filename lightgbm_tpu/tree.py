@@ -246,6 +246,84 @@ class Tree:
         return imp
 
 
+def tree_to_arrays(t: Tree, dataset: "BinnedDataset") -> "TreeArrays":
+    """Inverse of Tree.from_arrays: host model tree -> device TreeArrays
+    sized to the tree, for binned traversal (continued training seeds
+    scores, DART drops of loaded trees).
+
+    Thresholds map back through the dataset's bin boundaries; for models
+    trained on THIS binning the round-trip is exact (thresholds are bin
+    upper bounds), for foreign models the approximation is bounded by
+    one bin width — the same resolution training itself sees.
+    """
+    import jax.numpy as jnp
+
+    from .learner.grower import TreeArrays
+
+    L = t.num_leaves
+    n_nodes = L - 1
+    B = dataset.max_num_bin
+    used_of = {int(f): i for i, f in enumerate(dataset.used_features)}
+    nf = np.zeros(max(n_nodes, 1), np.int32)
+    nb = np.zeros(max(n_nodes, 1), np.int32)
+    ndl = np.zeros(max(n_nodes, 1), bool)
+    ncat = np.zeros(max(n_nodes, 1), bool)
+    nmask = np.zeros((max(n_nodes, 1), B), bool)
+    for i in range(n_nodes):
+        f_orig = int(t.split_feature[i])
+        m = dataset.mappers[f_orig]
+        dt = int(t.decision_type[i])
+        if f_orig not in used_of:
+            # split feature is trivial (constant) in THIS dataset: every
+            # row takes the same branch — resolve it host-side and encode
+            # as an always-left / always-right numerical node on feature 0
+            row = np.zeros(len(dataset.mappers))
+            row[f_orig] = m.min_value
+            go_l = bool(t.go_left(i, row))
+            nf[i] = 0
+            nb[i] = B + 1 if go_l else -1
+            continue
+        nf[i] = used_of[f_orig]
+        if dt & _CAT_MASK:
+            ncat[i] = True
+            ci = int(t.threshold[i])
+            lo, hi = int(t.cat_boundaries[ci]), int(t.cat_boundaries[ci + 1])
+            words = t.cat_threshold[lo:hi]
+            c2b = m._cat_to_bin or {}
+            for cv, b in c2b.items():
+                if cv // 32 < len(words) and (int(words[cv // 32]) >> (cv % 32)) & 1:
+                    if b < B:
+                        nmask[i, b] = True
+        else:
+            ndl[i] = bool(dt & _DEFAULT_LEFT_MASK)
+            nb[i] = int(
+                np.clip(
+                    np.searchsorted(m.upper_bounds, t.threshold[i], side="left"),
+                    0,
+                    max(m.num_bin - 1, 0),
+                )
+            )
+    z = np.zeros
+    return TreeArrays(
+        num_nodes=jnp.int32(n_nodes),
+        node_feature=jnp.asarray(nf),
+        node_bin=jnp.asarray(nb),
+        node_gain=jnp.asarray(np.asarray(t.split_gain, np.float32) if n_nodes else z(1, np.float32)),
+        node_default_left=jnp.asarray(ndl),
+        node_cat=jnp.asarray(ncat),
+        node_cat_mask=jnp.asarray(nmask),
+        node_left=jnp.asarray(np.asarray(t.left_child, np.int32) if n_nodes else z(1, np.int32)),
+        node_right=jnp.asarray(np.asarray(t.right_child, np.int32) if n_nodes else z(1, np.int32)),
+        node_value=jnp.asarray(np.asarray(t.internal_value, np.float32) if n_nodes else z(1, np.float32)),
+        node_weight=jnp.asarray(np.asarray(t.internal_weight, np.float32) if n_nodes else z(1, np.float32)),
+        node_count=jnp.asarray(np.asarray(t.internal_count, np.float32) if n_nodes else z(1, np.float32)),
+        leaf_value=jnp.asarray(np.asarray(t.leaf_value, np.float32)),
+        leaf_weight=jnp.asarray(np.asarray(t.leaf_weight, np.float32)),
+        leaf_count=jnp.asarray(np.asarray(t.leaf_count, np.float32)),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+    )
+
+
 def traverse_tree_bins(arrays: "TreeArrays", bins_fm, nan_bin):
     """Device traversal of a grown tree over a BINNED matrix -> per-row leaf.
 
