@@ -110,6 +110,7 @@ class Dataset:
             return self
         if self.data is None:
             log.fatal("Cannot construct Dataset: raw data was freed")
+        from .timer import global_timer as _gt
         arr, pandas_names = _to_2d_numpy(self.data)
         if isinstance(self.feature_name, list):
             names = [str(n) for n in self.feature_name]
@@ -124,19 +125,20 @@ class Dataset:
             ref_binned = self.reference._binned
         cat = self._resolve_categorical(names)
         keep_raw = bool(cfg.linear_tree)
-        self._binned = BinnedDataset.from_numpy(
-            arr,
-            cfg,
-            label=self.label,
-            weight=self.weight,
-            group=self.group,
-            init_score=self.init_score,
-            position=self.position,
-            categorical_feature=cat,
-            feature_names=names,
-            reference=ref_binned,
-            keep_raw=keep_raw,
-        )
+        with _gt.scope("dataset construct (binning)"):
+            self._binned = BinnedDataset.from_numpy(
+                arr,
+                cfg,
+                label=self.label,
+                weight=self.weight,
+                group=self.group,
+                init_score=self.init_score,
+                position=self.position,
+                categorical_feature=cat,
+                feature_names=names,
+                reference=ref_binned,
+                keep_raw=keep_raw,
+            )
         if self.free_raw_data:
             self.data = None
         return self

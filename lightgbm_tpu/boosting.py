@@ -42,6 +42,15 @@ class _ScoreSet:
     metrics: List[Metric] = field(default_factory=list)
 
 
+def _obj_grads(objective, score, it):
+    """Call an objective's gradient fn, passing the iteration to
+    stochastic objectives (rank_xendcg redraws its perturbation each
+    iteration; everything else ignores it)."""
+    if getattr(objective, "needs_iter", False):
+        return objective.get_gradients(score, it)
+    return objective.get_gradients(score)
+
+
 def _jit_traverse():
     import jax
 
@@ -274,7 +283,10 @@ class GBDT:
         import jax
         import jax.numpy as jnp
 
-        host = jax.device_get(self._pending)
+        from .timer import global_timer as _gt
+
+        with _gt.scope("materialize host trees (readback)"):
+            host = jax.device_get(self._pending)
         meta = self._pending_meta
         self._pending = []
         self._pending_meta = []
@@ -384,7 +396,7 @@ class GBDT:
                             vs.score = vs.score.at[k].add(init)
                         log.info(f"Start training from score {init:f}")
             score = self.train.score if K > 1 else self.train.score[0]
-            g, h = self.objective.get_gradients(score)
+            g, h = _obj_grads(self.objective, score, self.iter_)
             grad_dev = jnp.reshape(g, (K, -1)).astype(jnp.float32)
             hess_dev = jnp.reshape(h, (K, -1)).astype(jnp.float32)
         else:
@@ -616,7 +628,7 @@ class GBDT:
             shrink = state["shrink"]
             init_vec = state["init"]
             s_for_grad = score if K > 1 else score[0]
-            g, h = objective.get_gradients(s_for_grad)
+            g, h = _obj_grads(objective, s_for_grad, it)
             grad = jnp.reshape(g, (K, -1)).astype(jnp.float32)
             hess = jnp.reshape(h, (K, -1)).astype(jnp.float32)
             trees = []
@@ -990,8 +1002,8 @@ class GBDT:
 
         score = np.zeros((K, N), np.float64)
         for it in range(len(self.models) // K):
-            gs, hs = obj.get_gradients(jnp.asarray(
-                score if K > 1 else score[0], jnp.float32))
+            gs, hs = _obj_grads(obj, jnp.asarray(
+                score if K > 1 else score[0], jnp.float32), it)
             gs = np.asarray(gs, np.float64).reshape(K, N)
             hs = np.asarray(hs, np.float64).reshape(K, N)
             for k in range(K):
@@ -1218,7 +1230,7 @@ class RF(GBDT):
             np.repeat(np.asarray(self._rf_init_scores, np.float32)[:, None], npad, axis=1)
         )
         score = const if K > 1 else const[0]
-        g, h = self.objective.get_gradients(score)
+        g, h = _obj_grads(self.objective, score, 0)
         self._rf_grad = jnp.reshape(g, (K, -1)).astype(jnp.float32)
         self._rf_hess = jnp.reshape(h, (K, -1)).astype(jnp.float32)
 

@@ -113,10 +113,14 @@ def train(
         chunk = gbdt._check_every
         done = 0
         stop = False
+        from .timer import global_timer as _gt
+
         while done < num_boost_round and not stop:
             n = min(chunk, num_boost_round - done)
-            gbdt.fused_dispatch(n)
-            records = gbdt.fused_collect()
+            with _gt.scope("fused dispatch"):
+                gbdt.fused_dispatch(n)
+            with _gt.scope("fused collect (readback)"):
+                records = gbdt.fused_collect()
             for j, evals in enumerate(records):
                 i = done + j
                 evaluation_result_list = evals

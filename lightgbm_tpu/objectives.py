@@ -75,6 +75,14 @@ class ObjectiveFunction:
             return g * self.weight, h * self.weight
         return g, h
 
+    def _np_weight(self):
+        """Host weights truncated to real rows (None when unweighted)."""
+        return (
+            np.asarray(self.weight)[: self._num_data]
+            if self.weight is not None
+            else None
+        )
+
 
 # ---------------------------------------------------------------- regression
 class RegressionL2(ObjectiveFunction):
@@ -95,13 +103,6 @@ class RegressionL2(ObjectiveFunction):
         lab = np.asarray(self.label)[: self._num_data]
         w = self._np_weight()
         return float(np.average(lab, weights=w))
-
-    def _np_weight(self):
-        return (
-            np.asarray(self.weight)[: self._num_data]
-            if self.weight is not None
-            else None
-        )
 
     def convert_output(self, score):
         if self.config.reg_sqrt:
@@ -366,17 +367,64 @@ class CrossEntropy(ObjectiveFunction):
 
     def boost_from_score(self, class_id: int) -> float:
         lab = np.asarray(self.label)[: self._num_data]
-        w = (
-            np.asarray(self.weight)[: self._num_data]
-            if self.weight is not None
-            else None
-        )
-        pavg = float(np.average(lab, weights=w))
+        pavg = float(np.average(lab, weights=self._np_weight()))
         pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
         return float(np.log(pavg / (1.0 - pavg)))
 
     def convert_output(self, score):
         return 1.0 / (1.0 + np.exp(-score))
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    """reference xentropy_objective.hpp:185 CrossEntropyLambda
+    (alias xentlambda): weighted cross-entropy via the normalized
+    exponential parameterization; with unit weights it reduces to
+    plain cross-entropy."""
+
+    name = "cross_entropy_lambda"
+
+    def check_label(self, label):
+        if np.any(label < 0) or np.any(label > 1):
+            log.fatal("[cross_entropy_lambda]: labels must be in [0, 1]")
+
+    def init(self, dataset):
+        super().init(dataset)
+        if self.weight is not None:
+            wmin = float(np.asarray(self.weight)[: self._num_data].min())
+            if wmin <= 0:
+                log.fatal("[cross_entropy_lambda]: at least one weight is non-positive")
+
+    def get_gradients(self, score):
+        if self.weight is None:
+            z = jax.nn.sigmoid(score)
+            return z - self.label, z * (1.0 - z)
+        # reference computes in f64; on-device f32 needs stable forms and
+        # a saturation clamp (|s|>30 the loss is flat to f32 precision
+        # anyway): softplus/sigmoid instead of raw exp, which overflows
+        # at s>~88 and collapses z below its clamp at very negative s
+        w = self.weight
+        y = self.label
+        sc = jnp.clip(score, -30.0, 30.0)
+        epf = jnp.exp(sc)
+        hhat = jax.nn.softplus(sc)
+        z = 1.0 - jnp.exp(-w * hhat)
+        g = (1.0 - y / jnp.maximum(z, 1e-15)) * w * jax.nn.sigmoid(sc)
+        c = 1.0 / jnp.maximum(1.0 - z, 1e-15)
+        a = w * jax.nn.sigmoid(sc) * jax.nn.sigmoid(-sc)
+        d2 = jnp.maximum(c - 1.0, 1e-15)
+        b = (c / (d2 * d2)) * (1.0 + w * epf - c)
+        h = a * (1.0 + y * b)
+        return g, h
+
+    def boost_from_score(self, class_id: int) -> float:
+        lab = np.asarray(self.label)[: self._num_data]
+        havg = float(np.average(lab, weights=self._np_weight()))
+        return float(np.log(max(np.expm1(havg), 1e-15)))
+
+    def convert_output(self, score):
+        # the "normalized exponential parameter" lambda, not a probability;
+        # logaddexp = stable softplus (log1p(exp(s)) overflows at s>~709)
+        return np.logaddexp(0.0, score)
 
 
 # ---------------------------------------------------------------- ranking
@@ -462,6 +510,79 @@ def _weighted_percentile(values: np.ndarray, weights: np.ndarray, alpha: float) 
     return float(v[min(idx, len(v) - 1)])
 
 
+
+
+class RankXENDCG(ObjectiveFunction):
+    """reference rank_objective.hpp RankXENDCG: per-query softmax scores
+    against a stochastically perturbed 2^label ground-truth distribution,
+    with the three-term gradient series of the XE-NDCG loss. Fresh
+    uniforms are drawn per (iteration, document) — keyed RNG instead of
+    the reference's per-query stateful generators, so the whole gradient
+    stays one traced device function (fused-loop eligible)."""
+
+    name = "rank_xendcg"
+    is_ranking = True
+    is_device_gradients = True
+    needs_iter = True
+
+    def init(self, dataset):
+        super().init(dataset)
+        if self._meta.group is None:
+            log.fatal("rank_xendcg requires query group information")
+        from .learner.ranking import build_query_layout
+
+        npad = len(np.asarray(self.label))
+        layout = build_query_layout(self._meta.group, npad)
+        qdoc = jnp.asarray(layout.qdoc)
+        qvalid = jnp.asarray(layout.qvalid)
+        label_dev = jnp.asarray(self.label, jnp.float32)
+        weight_dev = self.weight
+        seed = int(self.config.objective_seed)
+        eps = 1e-15
+        NEG = jnp.float32(-1e30)
+
+        def _grads(score, it):
+            s = jnp.where(qvalid, score[jnp.clip(qdoc, 0, npad - 1)], NEG)
+            lb = jnp.where(qvalid, label_dev[jnp.clip(qdoc, 0, npad - 1)], 0.0)
+            rho = jax.nn.softmax(s, axis=1)  # Common::Softmax per query
+            key = jax.random.fold_in(jax.random.key(seed), it)
+            u = jax.random.uniform(key, qvalid.shape)
+            phi = jnp.where(qvalid, jnp.exp2(jnp.floor(lb)) - u, 0.0)
+            inv_den = 1.0 / jnp.maximum(
+                jnp.sum(phi, axis=1, keepdims=True), eps
+            )
+            t1 = -phi * inv_den + rho
+            p2 = t1 / jnp.maximum(1.0 - rho, eps)
+            sum1 = jnp.sum(jnp.where(qvalid, p2, 0.0), axis=1, keepdims=True)
+            t2 = rho * (sum1 - p2)
+            p3 = t2 / jnp.maximum(1.0 - rho, eps)
+            sum2 = jnp.sum(jnp.where(qvalid, p3, 0.0), axis=1, keepdims=True)
+            lam = t1 + t2 + rho * (sum2 - p3)
+            hess = rho * (1.0 - rho)
+            multi = (jnp.sum(qvalid, axis=1, keepdims=True) > 1)
+            ok = qvalid & multi
+            lam = jnp.where(ok, lam, 0.0)
+            hess = jnp.where(ok, hess, 0.0)
+            g = jnp.zeros(npad, jnp.float32).at[qdoc.reshape(-1)].add(
+                lam.reshape(-1), mode="drop"
+            )
+            h = jnp.zeros(npad, jnp.float32).at[qdoc.reshape(-1)].add(
+                hess.reshape(-1), mode="drop"
+            )
+            if weight_dev is not None:
+                g = g * weight_dev
+                h = h * weight_dev
+            return g, jnp.maximum(h, 2e-7)
+
+        self._grads = jax.jit(_grads)
+
+    def get_gradients(self, score, it=0):
+        return self._grads(score, jnp.asarray(it, jnp.int32))
+
+    def convert_output(self, score):
+        return score
+
+
 _OBJECTIVES: Dict[str, type] = {
     "regression": RegressionL2,
     "regression_l1": RegressionL1,
@@ -477,6 +598,8 @@ _OBJECTIVES: Dict[str, type] = {
     "multiclassova": MulticlassOVA,
     "cross_entropy": CrossEntropy,
     "lambdarank": LambdaRank,
+    "rank_xendcg": RankXENDCG,
+    "cross_entropy_lambda": CrossEntropyLambda,
 }
 
 

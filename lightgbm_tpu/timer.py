@@ -1,0 +1,82 @@
+"""Named per-phase accumulating timers (the reference's USE_TIMETAG
+subsystem: Timer/FunctionTimer, utils/common.h:979-1043, global_timer
+printed at exit, per-phase instrumentation across the tree learner and
+network layers — SURVEY §5).
+
+TPU adaptation: phases are HOST-side regions (dispatch, collect,
+binning, eval). Device work inside jit is asynchronous, so a scope that
+must include device completion passes `block=True` to synchronize
+before stopping the clock (used by bench/profilers, off in production
+paths). Scopes also enter `jax.profiler.TraceAnnotation`-compatible
+`jax.named_scope` so traces collected with jax.profiler line up with
+the same names.
+
+Enable summary-at-exit with env LIGHTGBM_TPU_TIMETAG=1 (the analog of
+the reference's compile-time USE_TIMETAG), or call
+`global_timer.print_summary()` directly.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional
+
+
+class Timer:
+    """Accumulating named stopwatches (reference utils/common.h:979)."""
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = {}
+        self._cnt: Dict[str, int] = {}
+        self.enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+
+    @contextmanager
+    def scope(self, name: str, block: bool = False) -> Iterator[None]:
+        """Time a region; with block=True waits for device completion
+        (jax.block_until_ready on nothing — a full device sync) before
+        stopping, so the region includes its dispatched work."""
+        if not self.enabled:
+            yield
+            return
+        import jax
+
+        t0 = time.perf_counter()
+        with jax.named_scope(name.replace(" ", "_")):
+            yield
+        if block:
+            try:
+                (jax.device_put(0) + 0).block_until_ready()
+            except Exception:  # noqa: BLE001 — never break the timed path
+                pass
+        dt = time.perf_counter() - t0
+        self._acc[name] = self._acc.get(name, 0.0) + dt
+        self._cnt[name] = self._cnt.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, tuple]:
+        return {
+            k: (self._acc[k], self._cnt[k])
+            for k in sorted(self._acc, key=lambda k: -self._acc[k])
+        }
+
+    def print_summary(self) -> None:
+        """common.h:1012 — per-phase totals at exit."""
+        from . import log
+
+        if not self._acc:
+            return
+        log.info("LightGBM-TPU phase timings:")
+        for name, (acc, cnt) in self.summary().items():
+            log.info(f"  {name}: {acc:.3f}s ({cnt} calls)")
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._cnt.clear()
+
+
+global_timer = Timer()
+
+if global_timer.enabled:
+    atexit.register(global_timer.print_summary)

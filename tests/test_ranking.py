@@ -202,3 +202,46 @@ def test_lambdarank_sklearn():
                         min_data_in_leaf=5)
     rk.fit(X, y, group=group)
     assert np.isfinite(rk.predict(X)).all()
+
+
+def test_rank_xendcg_trains_and_learns():
+    X, y, group = _rank_problem(nq=50, seed=13)
+    ds = lgb.Dataset(X, label=y, group=group, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "rank_xendcg", "metric": "ndcg", "eval_at": [5],
+         "num_leaves": 15, "learning_rate": 0.1, "verbosity": -1,
+         "min_data_in_leaf": 5},
+        ds, num_boost_round=25,
+        valid_sets=[ds], valid_names=["t"],
+    )
+    assert bst._gbdt.fused_eligible()
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import NDCGMetric
+
+    m = NDCGMetric(Config({"eval_at": [5]}))
+    m.init(y, None, group)
+    before = m.eval(np.zeros(len(y)))[0][1]
+    after = m.eval(bst.predict(X))[0][1]
+    assert after > before + 0.1, (before, after)
+
+
+def test_xentlambda_weighted_and_unweighted():
+    rs = np.random.RandomState(4)
+    X = rs.randn(1500, 5)
+    w = rs.randn(5)
+    y = 1.0 / (1.0 + np.exp(-(X @ w)))  # probabilistic labels in [0,1]
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "xentlambda", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=15)
+    pred = bst.predict(X)  # normalized exponential parameter (>0)
+    assert (pred > 0).all()
+    # with unit weights the gradient reduces to plain cross-entropy:
+    # implied probability 1-exp(-pred) should track the labels
+    p = 1.0 - np.exp(-pred)
+    assert np.corrcoef(p, y)[0, 1] > 0.9
+
+    wts = 0.5 + rs.rand(1500)
+    ds2 = lgb.Dataset(X, label=y, weight=wts, free_raw_data=False)
+    b2 = lgb.train({"objective": "xentlambda", "num_leaves": 15,
+                    "verbosity": -1}, ds2, num_boost_round=5)
+    assert np.isfinite(b2.predict(X)).all()

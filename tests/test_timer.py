@@ -1,0 +1,45 @@
+"""Phase-timer subsystem (reference USE_TIMETAG, utils/common.h:979)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.timer import Timer, global_timer
+
+
+def test_timer_accumulates_and_summarizes():
+    t = Timer()
+    t.enabled = True
+    with t.scope("phase a"):
+        pass
+    with t.scope("phase a"):
+        pass
+    with t.scope("phase b", block=True):
+        pass
+    s = t.summary()
+    assert s["phase a"][1] == 2
+    assert s["phase b"][1] == 1
+    assert all(v[0] >= 0 for v in s.values())
+    t.reset()
+    assert not t.summary()
+
+
+def test_training_records_phases(capsys):
+    was = global_timer.enabled
+    global_timer.enabled = True
+    global_timer.reset()
+    try:
+        rs = np.random.RandomState(0)
+        X = rs.randn(600, 4)
+        y = (X[:, 0] > 0).astype(float)
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                  ds, num_boost_round=3)
+        s = global_timer.summary()
+        assert "dataset construct (binning)" in s
+        assert any("dispatch" in k for k in s)
+        global_timer.print_summary()
+    finally:
+        global_timer.enabled = was
+        global_timer.reset()
