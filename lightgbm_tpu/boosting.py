@@ -96,6 +96,20 @@ class GBDT:
         from .config import warn_unimplemented
 
         warn_unimplemented(config)
+        # true-gradient leaf renewal bypasses the grower's monotone
+        # interval clamp and path smoothing — refuse the combination
+        # rather than silently violate a declared constraint
+        self._quant_renew_ok = True
+        if config.use_quantized_grad and config.quant_train_renew_leaf and (
+            config.path_smooth > 0
+            or (train_set.monotone_constraints is not None
+                and np.any(train_set.monotone_constraints != 0))
+        ):
+            self._quant_renew_ok = False
+            log.warning(
+                "quant_train_renew_leaf is disabled: true-gradient leaf "
+                "renewal would bypass monotone constraints / path_smooth"
+            )
 
         # ---- tree learner selection (reference tree_learner.cpp:17-59):
         # "data"/"voting" route growth through the sharded grower over a
@@ -199,6 +213,43 @@ class GBDT:
         if w is None:
             w = jnp.ones(self.train_set.num_rows_padded(), jnp.float32)
         return alpha, w
+
+    def _quantize(self, gk, hk, it, k):
+        """use_quantized_grad: discretize this tree's gradients
+        (gradient_discretizer.cpp DiscretizeGradients); traceable."""
+        import jax
+
+        from .learner.quantize import discretize_gradients
+
+        c = self.config
+        key = jax.random.fold_in(
+            jax.random.key(c.data_random_seed), it * self.num_class + k
+        )
+        return discretize_gradients(
+            gk, hk, key, c.num_grad_quant_bins, c.stochastic_rounding
+        )
+
+    def _grow_maybe_quantized(self, gk, hk, mask, feat_mask, valid, it, k):
+        """One tree: quantize gradients first when use_quantized_grad
+        (all paths — fast, fused, sync/DART, RF — share this so none can
+        silently skip quantization), optionally renewing leaf outputs
+        with the true gradients afterward."""
+        c = self.config
+        if not c.use_quantized_grad:
+            return self._grow(gk, hk, mask, feat_mask, valid)
+        gq, hq = self._quantize(gk, hk, it, k)
+        arrays, row_leaf = self._grow(gq, hq, mask, feat_mask, valid)
+        if c.quant_train_renew_leaf:
+            if self._quant_renew_ok:
+                from .learner.quantize import renew_leaf_with_true_gradients
+
+                arrays = arrays._replace(
+                    leaf_value=renew_leaf_with_true_gradients(
+                        arrays.leaf_value, row_leaf, gk, hk, mask,
+                        self.params, self.spec.num_leaves,
+                    )
+                )
+        return arrays, row_leaf
 
     def _apply_renewal(self, arrays, row_leaf, score_k, mask, renew_alpha,
                        renew_w):
@@ -429,7 +480,9 @@ class GBDT:
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
             feat_mask = self._sample_features(k=k)
-            arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, self.dev["valid"])
+            arrays, row_leaf = self._grow_maybe_quantized(
+                gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
+            )
             ok = (arrays.num_nodes > 0).astype(jnp.float32)
             if renew_alpha is not None:
                 arrays = self._apply_renewal(
@@ -484,7 +537,9 @@ class GBDT:
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
             feat_mask = self._sample_features(k=k)
-            arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, self.dev["valid"])
+            arrays, row_leaf = self._grow_maybe_quantized(
+                gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
+            )
             n_nodes = int(arrays.num_nodes)
             if n_nodes > 0:
                 should_continue = True
@@ -642,7 +697,9 @@ class GBDT:
                     feat_mask = jax.random.permutation(fkey, F) < n_feat
                 else:
                     feat_mask = jnp.ones(F, dtype=bool)
-                arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, dev["valid"])
+                arrays, row_leaf = self._grow_maybe_quantized(
+                    gk, hk, mask, feat_mask, dev["valid"], it, k
+                )
                 ok = (arrays.num_nodes > 0).astype(jnp.float32)
                 if renew_alpha is not None:
                     # percentile leaf refit on device (RenewTreeOutput,
@@ -1248,7 +1305,9 @@ class RF(GBDT):
                 self.iter_, gk, hk, self.dev["valid"], self._label_dev
             )
             feat_mask = self._sample_features(k=k)
-            arrays, row_leaf = self._grow(gk, hk, mask, feat_mask, self.dev["valid"])
+            arrays, row_leaf = self._grow_maybe_quantized(
+                gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
+            )
             n_nodes = int(arrays.num_nodes)
             init_k = self._rf_init_scores[k]
             if n_nodes > 0:

@@ -390,7 +390,6 @@ _UNIMPLEMENTED = (
     ("cegb_penalty_split", 0.0, "cost-effective gradient boosting penalties are not implemented yet"),
     ("cegb_penalty_feature_lazy", (), "cost-effective gradient boosting penalties are not implemented yet"),
     ("cegb_penalty_feature_coupled", (), "cost-effective gradient boosting penalties are not implemented yet"),
-    ("use_quantized_grad", False, "quantized-gradient training is not implemented yet"),
     ("lambdarank_position_bias_regularization", 0.0, "position bias debiasing is not implemented yet"),
 )
 

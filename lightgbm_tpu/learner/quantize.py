@@ -1,0 +1,71 @@
+"""Quantized-gradient training (use_quantized_grad).
+
+Reference: src/treelearner/gradient_discretizer.cpp:22 — per-iteration
+gradient/hessian discretization to num_grad_quant_bins levels with
+stochastic rounding (truncation toward zero of x/scale +- u), scales
+g_scale = max|g| / (bins/2), h_scale = max|h| / bins, and optional
+true-gradient leaf renewal (quant_train_renew_leaf,
+RenewIntGradTreeOutput).
+
+TPU formulation: the quantized levels flow through the standard
+histogram kernel as DEQUANTIZED f32 values (level * scale) — the
+accumulated sums equal the reference's int-histogram sums times the
+scales up to f32 addition rounding, so split decisions match the
+quantized semantics without new kernels. The deferred perf half
+(int8 one-hot matmuls on the MXU + int16 psum payloads, the analog of
+bin.h:63-81 wire reducers) slots in behind this same interface.
+
+Randomness is keyed on (seed, iteration) — the reference's
+pre-generated random value table with a rotating start offset
+(gradient_discretizer.cpp:25-41) serves the same purpose.
+"""
+
+from __future__ import annotations
+
+
+def discretize_gradients(
+    grad,
+    hess,
+    key,
+    num_bins: int,
+    stochastic: bool,
+):
+    """(grad, hess) -> dequantized (grad_q, hess_q) at num_bins levels.
+
+    Matches DiscretizeGradients: grad levels in [-bins/2, bins/2],
+    hess levels in [0, bins]; stochastic rounding truncates toward zero
+    after adding signed uniform noise, plain rounding truncates after
+    adding 0.5.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    g_scale = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-30) / (num_bins // 2)
+    h_scale = jnp.maximum(jnp.max(jnp.abs(hess)), 1e-30) / num_bins
+    if stochastic:
+        kg, kh = jax.random.split(key)
+        ug = jax.random.uniform(kg, grad.shape)
+        uh = jax.random.uniform(kh, hess.shape)
+    else:
+        ug = 0.5
+        uh = 0.5
+    gq = jnp.trunc(grad / g_scale + jnp.sign(grad) * ug)
+    hq = jnp.trunc(hess / h_scale + uh)  # hessians are non-negative
+    return gq * g_scale, hq * h_scale
+
+
+def renew_leaf_with_true_gradients(leaf_value, row_leaf, grad, hess, mask,
+                                   params, num_leaves: int):
+    """quant_train_renew_leaf: recompute leaf outputs from the TRUE
+    (unquantized) per-leaf gradient/hessian sums
+    (gradient_discretizer RenewIntGradTreeOutput)."""
+    import jax.numpy as jnp
+
+    from .split import leaf_output
+
+    L = num_leaves
+    idx = jnp.where((row_leaf >= 0) & (mask > 0), row_leaf, L)
+    sum_g = jnp.zeros(L, jnp.float32).at[idx].add(grad * mask, mode="drop")
+    sum_h = jnp.zeros(L, jnp.float32).at[idx].add(hess * mask, mode="drop")
+    renewed = leaf_output(sum_g, sum_h, params)
+    return jnp.where(sum_h > 0, renewed, leaf_value)

@@ -525,6 +525,10 @@ class RankXENDCG(ObjectiveFunction):
     is_device_gradients = True
     needs_iter = True
 
+    def check_label(self, label):
+        if np.any(label < 0):
+            log.fatal("[rank_xendcg]: relevance labels must be non-negative")
+
     def init(self, dataset):
         super().init(dataset)
         if self._meta.group is None:
