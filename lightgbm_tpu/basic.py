@@ -462,7 +462,28 @@ class Booster:
             return self._gbdt.predict_leaf_index(arr, start_iteration, num_iteration)
         if pred_contrib:
             return self._gbdt.predict_contrib(arr, start_iteration, num_iteration)
-        return self._gbdt.predict(arr, start_iteration, num_iteration, raw_score=raw_score)
+        # prediction early stop (reference c_api predict parameter
+        # parsing; kwargs mirror the parameter names)
+        early_stop = None
+        if kwargs.get("pred_early_stop", self.params.get("pred_early_stop", False)):
+            # classification only (reference Predictor picks CreateNone
+            # for everything else, prediction_early_stop.cpp:18)
+            is_cls = self._gbdt.num_class > 1 or getattr(
+                self.config, "objective", ""
+            ) in ("binary", "cross_entropy", "cross_entropy_lambda")
+            if is_cls:
+                early_stop = (
+                    int(kwargs.get("pred_early_stop_freq",
+                                   self.params.get("pred_early_stop_freq", 10))),
+                    float(kwargs.get("pred_early_stop_margin",
+                                     self.params.get("pred_early_stop_margin", 10.0))),
+                )
+            else:
+                log.warning(
+                    "pred_early_stop only applies to classification; ignored"
+                )
+        return self._gbdt.predict(arr, start_iteration, num_iteration,
+                                  raw_score=raw_score, early_stop=early_stop)
 
     # ------------------------------------------------------------------
     def model_to_string(

@@ -147,7 +147,9 @@ class GBDT:
         # padded per-row arrays and must see the final row padding
         if self.objective is not None:
             self.objective.init(train_set)
-        self.strategy = create_sample_strategy(config, train_set.num_data)
+        self.strategy = create_sample_strategy(
+            config, train_set.num_data, group=train_set.metadata.group
+        )
         self.dev = train_set.device_arrays()
         from .binning import BinType
 
@@ -964,22 +966,51 @@ class GBDT:
         X: np.ndarray,
         start_iteration: int = 0,
         num_iteration: int = -1,
+        early_stop: Optional[Tuple[int, float]] = None,
     ) -> np.ndarray:
-        """Raw margin prediction over host trees (gbdt_prediction.cpp)."""
+        """Raw margin prediction over host trees (gbdt_prediction.cpp).
+
+        early_stop = (freq, margin_threshold) enables the reference's
+        per-row prediction early stop (prediction_early_stop.cpp): every
+        freq iterations, rows whose margin — 2|p| for binary/regression,
+        top1-top2 for multiclass — exceeds the threshold stop
+        accumulating further trees (vectorized over rows here)."""
         X = np.asarray(X, dtype=np.float64)
         K = self.num_class
         n_iters = len(self.models) // K
         end = n_iters if num_iteration <= 0 else min(n_iters, start_iteration + num_iteration)
         out = np.zeros((K, X.shape[0]))
-        for it in range(start_iteration, end):
-            for k in range(K):
-                out[k] += self.models[it * K + k].predict(X)
+        if early_stop is None:
+            for it in range(start_iteration, end):
+                for k in range(K):
+                    out[k] += self.models[it * K + k].predict(X)
+        else:
+            freq, margin_thr = early_stop
+            active = np.ones(X.shape[0], bool)
+            Xa = X  # resliced only when rows deactivate
+            for it in range(start_iteration, end):
+                for k in range(K):
+                    out[k][active] += self.models[it * K + k].predict(Xa)
+                if (it - start_iteration + 1) % max(freq, 1) == 0:
+                    if K >= 2:
+                        part = np.partition(out[:, active], K - 2, axis=0)
+                        margin = part[K - 1] - part[K - 2]
+                    else:
+                        margin = 2.0 * np.abs(out[0][active])
+                    keep = margin <= margin_thr
+                    idx = np.flatnonzero(active)
+                    active[idx[~keep]] = False
+                    if not active.any():
+                        break
+                    Xa = X[active]
         if self.average_output and end > start_iteration:
             out /= end - start_iteration
         return out
 
-    def predict(self, X, start_iteration=0, num_iteration=-1, raw_score=False):
-        raw = self.predict_raw(X, start_iteration, num_iteration)
+    def predict(self, X, start_iteration=0, num_iteration=-1, raw_score=False,
+                early_stop=None):
+        raw = self.predict_raw(X, start_iteration, num_iteration,
+                               early_stop=early_stop)
         if not raw_score and self.objective is not None:
             raw = self.objective.convert_output(raw)
         if self.num_class == 1:

@@ -190,8 +190,18 @@ def _task_predict(params: Dict[str, str]) -> None:
     raw = str(params.get("predict_raw_score", "false")).lower() in ("true", "1")
     leaf = str(params.get("predict_leaf_index", "false")).lower() in ("true", "1")
     contrib = str(params.get("predict_contrib", "false")).lower() in ("true", "1")
+    es_kwargs = {}
+    if str(params.get("pred_early_stop", "false")).lower() in ("true", "1"):
+        es_kwargs = {
+            "pred_early_stop": True,
+            "pred_early_stop_freq": int(params.get("pred_early_stop_freq", 10)),
+            "pred_early_stop_margin": float(
+                params.get("pred_early_stop_margin", 10.0)
+            ),
+        }
     pred = bst.predict(
-        loaded["X"], raw_score=raw, pred_leaf=leaf, pred_contrib=contrib
+        loaded["X"], raw_score=raw, pred_leaf=leaf, pred_contrib=contrib,
+        **es_kwargs,
     )
     out = params.get("output_result", "LightGBM_predict_result.txt")
     pred2 = np.atleast_2d(pred.T).T  # (N, K) even for 1-D

@@ -386,7 +386,6 @@ _UNIMPLEMENTED = (
     ("feature_fraction_bynode", 1.0, "per-node feature sampling is not implemented yet (per-tree feature_fraction works)"),
     ("interaction_constraints", "", "interaction constraints are not implemented yet"),
     ("forcedsplits_filename", "", "forced splits are not implemented yet"),
-    ("bagging_by_query", False, "query-level bagging is not implemented yet (row-level bagging works)"),
     ("cegb_penalty_split", 0.0, "cost-effective gradient boosting penalties are not implemented yet"),
     ("cegb_penalty_feature_lazy", (), "cost-effective gradient boosting penalties are not implemented yet"),
     ("cegb_penalty_feature_coupled", (), "cost-effective gradient boosting penalties are not implemented yet"),

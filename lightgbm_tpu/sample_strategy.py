@@ -39,11 +39,27 @@ class SampleStrategy:
         return valid, grad, hess
 
 
-class BaggingStrategy(SampleStrategy):
-    """bagging_fraction/bagging_freq (+ pos/neg fractions) via Bernoulli
-    masks regenerated every `bagging_freq` iterations (bagging.hpp:30)."""
+def _exact_fraction_mask(u, eligible, frac):
+    """Select exactly round(frac * #eligible) rows: the rows whose
+    uniform draw is below the k-th smallest among eligible rows. The
+    reference samples exact counts (bagging.hpp via
+    ParallelPartitionRunner); a plain Bernoulli mask would make bag
+    sizes binomial."""
+    n_elig = jnp.sum(eligible)
+    k = jnp.round(n_elig * frac).astype(jnp.int32)
+    ue = jnp.where(eligible, u, jnp.inf)
+    sorted_u = jnp.sort(ue)
+    # threshold = k-th smallest (k>=1); k==0 selects nothing
+    thr = sorted_u[jnp.maximum(k - 1, 0)]
+    return eligible & (u <= thr) & (k > 0)
 
-    def __init__(self, config: Config, num_data: int):
+
+class BaggingStrategy(SampleStrategy):
+    """bagging_fraction/bagging_freq (+ pos/neg fractions, + query-level
+    bagging_by_query) with EXACT bag sizes, masks regenerated every
+    `bagging_freq` iterations (bagging.hpp:30)."""
+
+    def __init__(self, config: Config, num_data: int, group=None):
         super().__init__(config, num_data)
         c = config
         self.use_pos_neg = (
@@ -52,6 +68,30 @@ class BaggingStrategy(SampleStrategy):
         self.enabled = c.bagging_freq > 0 and (
             c.bagging_fraction < 1.0 or self.use_pos_neg
         )
+        self.by_query = bool(c.bagging_by_query)
+        self._row_query = None
+        if self.by_query and self.use_pos_neg:
+            from . import log
+
+            log.warning(
+                "bagging_by_query ignores pos/neg_bagging_fraction; "
+                "using row-level pos/neg bagging instead"
+            )
+            self.by_query = False
+        if self.by_query:
+            if group is None:
+                from . import log
+
+                log.warning(
+                    "bagging_by_query requires query groups; using row-level bagging"
+                )
+                self.by_query = False
+            else:
+                g = np.asarray(group, dtype=np.int64)
+                self._num_queries = len(g)
+                self._row_query = jnp.asarray(
+                    np.repeat(np.arange(len(g), dtype=np.int32), g)
+                )
 
     def sample(self, iter_num, grad, hess, valid, label):
         """iter_num may be a host int or a traced int32 (fused loop): the
@@ -64,15 +104,31 @@ class BaggingStrategy(SampleStrategy):
         it = jnp.asarray(iter_num, jnp.int32)
         window = (it // c.bagging_freq) * c.bagging_freq
         key = jax.random.fold_in(jax.random.key(c.bagging_seed), window)
-        u = jax.random.uniform(key, valid.shape)
-        if self.use_pos_neg and label is not None:
-            frac = jnp.where(
-                label > 0, c.pos_bagging_fraction, c.neg_bagging_fraction
+        if self.by_query:
+            # sample exact round(frac * Q) whole queries (bagging.hpp
+            # bagging_by_query)
+            uq = jax.random.uniform(key, (self._num_queries,))
+            qsel = _exact_fraction_mask(
+                uq, jnp.ones(self._num_queries, bool), c.bagging_fraction
             )
+            n = self._row_query.shape[0]
+            rowsel = jnp.zeros(valid.shape, bool).at[:n].set(
+                qsel[self._row_query]
+            )
+            return rowsel.astype(jnp.float32) * valid, grad, hess
+        u = jax.random.uniform(key, valid.shape)
+        elig = valid > 0
+        if self.use_pos_neg and label is not None:
+            pos = _exact_fraction_mask(
+                u, elig & (label > 0), c.pos_bagging_fraction
+            )
+            neg = _exact_fraction_mask(
+                u, elig & (label <= 0), c.neg_bagging_fraction
+            )
+            mask = pos | neg
         else:
-            frac = c.bagging_fraction
-        mask = (u < frac).astype(jnp.float32) * valid
-        return mask, grad, hess
+            mask = _exact_fraction_mask(u, elig, c.bagging_fraction)
+        return mask.astype(jnp.float32) * valid, grad, hess
 
 
 class GOSSStrategy(SampleStrategy):
@@ -119,8 +175,8 @@ class GOSSStrategy(SampleStrategy):
         return lax.cond(it >= warmup, _goss, _no_sample, None)
 
 
-def create_sample_strategy(config: Config, num_data: int) -> SampleStrategy:
+def create_sample_strategy(config: Config, num_data: int, group=None) -> SampleStrategy:
     """Factory (reference sample_strategy.cpp:15)."""
     if config.data_sample_strategy == "goss":
         return GOSSStrategy(config, num_data)
-    return BaggingStrategy(config, num_data)
+    return BaggingStrategy(config, num_data, group=group)

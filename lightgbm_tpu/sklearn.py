@@ -335,6 +335,7 @@ class LGBMModel(_LGBMModelBase):
             pred_leaf=pred_leaf,
             pred_contrib=pred_contrib,
             validate_features=validate_features,
+            **kwargs,
         )
 
     # -- fitted attributes ----------------------------------------------

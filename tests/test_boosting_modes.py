@@ -140,3 +140,45 @@ def test_dart_custom_objective_sees_dropout():
     internal = bst._gbdt.get_score(bst._gbdt.train)[0]
     raw = bst.predict(X, raw_score=True)
     np.testing.assert_allclose(internal, raw, rtol=2e-4, atol=2e-5)
+
+
+def test_bagging_exact_count():
+    """Bag sizes are exact (reference samples exactly frac*N rows, not a
+    Bernoulli draw)."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.sample_strategy import BaggingStrategy
+
+    c = Config({"bagging_fraction": 0.5, "bagging_freq": 1})
+    n = 10000
+    st = BaggingStrategy(c, n)
+    valid = jnp.ones(n, jnp.float32)
+    g = jnp.zeros(n)
+    for it in (0, 1, 5):
+        mask, _, _ = st.sample(it, g, g, valid, None)
+        assert int(mask.sum()) == 5000, int(mask.sum())
+
+
+def test_bagging_by_query():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.sample_strategy import BaggingStrategy
+
+    group = np.asarray([10, 20, 5, 15, 30, 20])
+    n = int(group.sum())
+    c = Config({"bagging_fraction": 0.5, "bagging_freq": 1,
+                "bagging_by_query": True})
+    st = BaggingStrategy(c, n, group=group)
+    valid = jnp.ones(n, jnp.float32)
+    g = jnp.zeros(n)
+    mask, _, _ = st.sample(0, g, g, valid, None)
+    m = np.asarray(mask)
+    qb = np.concatenate([[0], np.cumsum(group)])
+    picked = [m[qb[q]:qb[q + 1]] for q in range(len(group))]
+    # whole queries in or out, exactly half the queries selected
+    assert all((p == p[0]).all() for p in picked)
+    assert sum(int(p[0]) for p in picked) == 3

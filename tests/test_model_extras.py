@@ -84,3 +84,50 @@ def test_refit(binary_booster, binary_problem):
     flipped = new_bst.predict(X[:20])
     # refit on inverted labels must push predictions the other way
     assert np.corrcoef(before, flipped)[0, 1] < 0.5
+
+
+def test_pred_early_stop():
+    """prediction_early_stop.cpp: rows with a confident margin stop
+    accumulating trees; with a huge margin threshold predictions match
+    the full walk exactly."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rs = np.random.RandomState(3)
+    X = rs.randn(800, 5)
+    y = ((X[:, 0] + 0.2 * rs.randn(800)) > 0).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=40)
+    full = bst.predict(X)
+    # threshold so large nothing stops -> identical
+    same = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                       pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(same, full, rtol=0, atol=0)
+    # aggressive margin: predictions approximate but rank-correlated
+    fast = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                       pred_early_stop_margin=2.0)
+    assert not np.allclose(fast, full)
+    assert np.corrcoef(fast, full)[0, 1] > 0.95
+    # classification preserved for confident rows
+    agree = ((fast > 0.5) == (full > 0.5)).mean()
+    assert agree > 0.95, agree
+
+
+def test_pred_early_stop_multiclass():
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rs = np.random.RandomState(5)
+    X = rs.randn(900, 4)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    ds = lgb.Dataset(X, label=y.astype(float), free_raw_data=False)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1}, ds,
+                    num_boost_round=20)
+    full = bst.predict(X)
+    fast = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=3,
+                       pred_early_stop_margin=3.0)
+    assert (np.argmax(fast, 1) == np.argmax(full, 1)).mean() > 0.95
