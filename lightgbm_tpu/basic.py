@@ -362,7 +362,7 @@ class Booster:
             for ss in [gb.train] + gb.valids:
                 dev = gb.dev if ss is gb.train else ss.dataset.device_arrays()
                 if t.num_leaves > 1:
-                    leaf = gb._traverse(arrays, dev["bins"], dev["nan_bin"])
+                    leaf = gb._traverse(arrays, dev["bins"], dev["nan_bin"], dev.get("bundle"))
                     ss.score = ss.score.at[k].add(arrays.leaf_value[leaf])
                 else:
                     ss.score = ss.score.at[k].add(float(t.leaf_value[0]))

@@ -129,9 +129,10 @@ class GBDT:
 
             if config.tree_learner == "voting":
                 log.info(
-                    "tree_learner=voting: histogram reduction is an XLA "
-                    "psum over ICI; using the data-parallel grower "
-                    "(identical results)"
+                    f"tree_learner=voting: top-{config.top_k} local-gain "
+                    "vote elects features per split; only elected columns "
+                    "are psum'd across the mesh "
+                    "(voting_parallel_tree_learner.cpp semantics)"
                 )
             self._mesh = make_mesh()
             blk = HIST_BLK
@@ -158,12 +159,29 @@ class GBDT:
             and m.num_bin > config.max_cat_to_onehot
             for m in train_set.used_mappers()
         )
+        use_voting = (
+            config.tree_learner == "voting"
+            and self._mesh is not None
+            and train_set.bundle_layout is None
+        )
+        if (config.tree_learner == "voting" and self._mesh is not None
+                and train_set.bundle_layout is not None):
+            log.warning(
+                "tree_learner=voting is disabled because EFB bundled this "
+                "dataset (feature != column); falling back to full "
+                "histogram psum (tree_learner=data). Set "
+                "enable_bundle=false to use the voting election."
+            )
         self.spec = GrowerSpec(
             num_leaves=config.num_leaves,
             num_bins=train_set.max_num_bin,
             max_depth=config.max_depth,
             axis_name="data" if self._mesh is not None else None,
             cat_subset=cat_subset,
+            efb=train_set.bundle_layout is not None,
+            col_bins=train_set.col_bins,
+            rounds=config.tpu_growth_rounds and not use_voting,
+            voting_k=config.top_k if use_voting else 0,
         )
         self.params = make_split_params(config)
         self.train = _ScoreSet(
@@ -277,10 +295,12 @@ class GBDT:
             return self._dp(
                 d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
                 gk, hk, mask, feat_mask, self.params, valid,
+                d.get("bundle"),
             )
         return grow_tree(
             d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
             gk, hk, mask, feat_mask, self.params, self.spec, valid=valid,
+            bundle=d.get("bundle"),
         )
 
     # ------------------------------------------------------------------
@@ -376,14 +396,15 @@ class GBDT:
                     arrays, _ = self.device_trees[base + j]
                     k = meta[j][0]
                     leaf = self._traverse(
-                        arrays, self.dev["bins"], self.dev["nan_bin"]
+                        arrays, self.dev["bins"], self.dev["nan_bin"],
+                        self.dev.get("bundle"),
                     )
                     self.train.score = self.train.score.at[k].add(
                         -arrays.leaf_value[leaf]
                     )
                     for vs in self.valids:
                         vdev = vs.dataset.device_arrays()
-                        vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                        vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
                         vs.score = vs.score.at[k].add(-arrays.leaf_value[vleaf])
                 log.warning(
                     "Stopped training because there are no more leaves that meet the split requirements"
@@ -501,7 +522,7 @@ class GBDT:
             )
             for vs in self.valids:
                 vdev = vs.dataset.device_arrays()
-                leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
                 vs.score = vs.score.at[k].set(
                     add_score(vs.score[k], leaf, lv, one)
                 )
@@ -561,7 +582,7 @@ class GBDT:
                 )
                 for vs in self.valids:
                     vdev = vs.dataset.device_arrays()
-                    leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                    leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
                     vs.score = vs.score.at[k].set(
                         add_score(vs.score[k], leaf, final_leaf, one)
                     )
@@ -714,7 +735,7 @@ class GBDT:
                 score = score.at[k].set(add_score(score[k], row_leaf, lv, one))
                 new_vs = []
                 for vi in range(n_valid_sets):
-                    vleaf = traverse(arrays, vdevs[vi]["bins"], vdevs[vi]["nan_bin"])
+                    vleaf = traverse(arrays, vdevs[vi]["bins"], vdevs[vi]["nan_bin"], vdevs[vi].get("bundle"))
                     new_vs.append(
                         vscores[vi].at[k].set(
                             add_score(vscores[vi][k], vleaf, lv, one)
@@ -833,13 +854,13 @@ class GBDT:
             arrays, _ = self.device_trees[mi]
             k = mi % K
             if self._models[mi].num_leaves > 1:
-                leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"])
+                leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"], self.dev.get("bundle"))
                 self.train.score = self.train.score.at[k].add(
                     -arrays.leaf_value[leaf]
                 )
                 for vs in self.valids:
                     vdev = vs.dataset.device_arrays()
-                    vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                    vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
                     vs.score = vs.score.at[k].add(-arrays.leaf_value[vleaf])
         del self._models[n_iters * K:]
         del self.device_trees[n_iters * K:]
@@ -914,11 +935,11 @@ class GBDT:
             tree = self.models.pop()
             arrays, _ = self.device_trees.pop()
             if tree.num_leaves > 1:
-                leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"])
+                leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"], self.dev.get("bundle"))
                 self.train.score = self.train.score.at[k].add(-arrays.leaf_value[leaf])
                 for vs in self.valids:
                     vdev = vs.dataset.device_arrays()
-                    vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                    vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
                     vs.score = vs.score.at[k].add(-arrays.leaf_value[vleaf])
             else:
                 # stump: its constant (boost-from-score bias) was added to
@@ -1166,7 +1187,7 @@ class DART(GBDT):
         import jax.numpy as jnp
 
         dev = ss.dataset.device_arrays()
-        leaf = self._traverse(arrays, dev["bins"], dev["nan_bin"])
+        leaf = self._traverse(arrays, dev["bins"], dev["nan_bin"], dev.get("bundle"))
         ss.score = ss.score.at[k].set(
             add_score(ss.score[k], leaf, arrays.leaf_value, jnp.float32(scale))
         )
@@ -1364,7 +1385,7 @@ class RF(GBDT):
             self.train.score = self.train.score.at[k].set(sc / (m + 1.0))
             for vs in self.valids:
                 vdev = vs.dataset.device_arrays()
-                leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
                 vsc = vs.score[k] * m
                 vsc = add_score(vsc, leaf, arrays.leaf_value, jnp.float32(1.0))
                 vs.score = vs.score.at[k].set(vsc / (m + 1.0))
@@ -1381,12 +1402,12 @@ class RF(GBDT):
         for k in reversed(range(K)):
             self.models.pop()
             arrays, _ = self.device_trees.pop()
-            leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"])
+            leaf = self._traverse(arrays, self.dev["bins"], self.dev["nan_bin"], self.dev.get("bundle"))
             sc = self.train.score[k] * m - arrays.leaf_value[leaf]
             self.train.score = self.train.score.at[k].set(sc / (m - 1.0) if m > 1 else sc * 0)
             for vs in self.valids:
                 vdev = vs.dataset.device_arrays()
-                vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"])
+                vleaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
                 vsc = vs.score[k] * m - arrays.leaf_value[vleaf]
                 vs.score = vs.score.at[k].set(vsc / (m - 1.0) if m > 1 else vsc * 0)
         self.iter_ -= 1

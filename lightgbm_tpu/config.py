@@ -179,6 +179,11 @@ _PARAMS: Dict[str, _P] = {
     "num_gpu": (1, int, (), _pos),
     # ---- TPU-specific extensions (not in reference) ----
     "tpu_row_block": (0, int, (), _nonneg),  # 0 = auto; rows per histogram matmul block
+    # round-batched growth: split every positive-gain leaf per device
+    # step (multi-leaf histograms + one sort per round). Faster on TPU,
+    # but once num_leaves binds the tree differs from exact leaf-wise
+    # greedy (best-first); off by default for reference parity.
+    "tpu_growth_rounds": (False, bool, (), None),
     "tpu_hist_dtype": ("float32", str, (), None),
     "tpu_mesh_axes": ("data", str, (), None),
 }

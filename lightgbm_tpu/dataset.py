@@ -80,6 +80,10 @@ class BinnedDataset:
     row_block: int
     monotone_constraints: Optional[np.ndarray] = None  # per used feature, in {-1,0,1}
     raw_data: Optional[np.ndarray] = None  # kept for linear trees / refit
+    # EFB (bundling.py): when set, `bins` holds BUNDLE columns (G, N)
+    # and these describe the feature -> column mapping
+    bundle_layout: Optional[Any] = None
+    bundle_expand: Optional[np.ndarray] = None  # (F, max_num_bin) int32
     _device: Optional[Dict[str, Any]] = field(default=None, repr=False)
 
     # ---------------- construction ----------------
@@ -173,6 +177,39 @@ class BinnedDataset:
         for i, f in enumerate(used):
             bins[i] = mappers[f].values_to_bins(data[:, f]).astype(dtype)
 
+        # EFB bundling (dataset.cpp:111 FindGroups / :250
+        # FastFeatureBundling): merge near-exclusive sparse features into
+        # shared columns. A reference dataset's layout is reused verbatim
+        # (valid sets must bin + bundle identically).
+        bundle_layout = None
+        bundle_expand = None
+        if reference is not None:
+            bundle_layout = reference.bundle_layout
+            bundle_expand = reference.bundle_expand
+            if bundle_layout is not None:
+                from .bundling import encode
+
+                um = [mappers[f] for f in used]
+                merged, _ = encode(
+                    bins, bundle_layout,
+                    [m.num_bin for m in um],
+                    [m.most_freq_bin for m in um],
+                    _choose_bin_dtype(bundle_layout.col_bins),
+                )
+                bins = merged
+        elif config.enable_bundle and len(used) > 1:
+            from .bundling import bundle_features
+
+            um = [mappers[f] for f in used]
+            res = bundle_features(bins, um, config.max_bin)
+            if res is not None:
+                bins, bundle_layout, bundle_expand = res
+                log.info(
+                    f"EFB: bundled {len(used)} features into "
+                    f"{bundle_layout.num_columns} columns "
+                    f"(col bins={bundle_layout.col_bins})"
+                )
+
         meta = Metadata(
             label=None if label is None else np.asarray(label, dtype=np.float32).ravel(),
             weight=None if weight is None else np.asarray(weight, dtype=np.float32).ravel(),
@@ -203,6 +240,8 @@ class BinnedDataset:
             row_block=row_block,
             monotone_constraints=mono,
             raw_data=data if keep_raw else None,
+            bundle_layout=bundle_layout,
+            bundle_expand=bundle_expand,
         )
 
     def copy_subrow(self, indices: np.ndarray) -> "BinnedDataset":
@@ -255,6 +294,8 @@ class BinnedDataset:
             row_block=self.row_block,
             monotone_constraints=self.monotone_constraints,
             raw_data=None if self.raw_data is None else self.raw_data[idx],
+            bundle_layout=self.bundle_layout,
+            bundle_expand=self.bundle_expand,
         )
 
     # ---------------- derived host info ----------------
@@ -309,7 +350,8 @@ class BinnedDataset:
 
         npad = self.num_rows_padded()
         f = self.num_used_features
-        bins_fm = np.zeros((f, npad), dtype=np.int32)
+        ncols = self.bins.shape[0]  # bundle columns (== f without EFB)
+        bins_fm = np.zeros((ncols, npad), dtype=np.int32)
         bins_fm[:, : self.num_data] = self.bins
         um = self.used_mappers()
         nan_bin = np.array([m.nan_bin for m in um], dtype=np.int32)
@@ -329,8 +371,38 @@ class BinnedDataset:
             "num_bins": jnp.asarray(num_bins),
             "mono": jnp.asarray(mono),
             "is_cat": jnp.asarray(is_cat),
+            "bundle": self._bundle_info(),
         }
         return self._device
+
+    def _bundle_info(self):
+        """Device BundleInfo for the growers, or None without EFB."""
+        if self.bundle_layout is None:
+            return None
+        import jax.numpy as jnp
+
+        from .learner.bundle import BundleInfo
+
+        lay = self.bundle_layout
+        um = self.used_mappers()
+        width = np.array(
+            [m.num_bin - (1 if lay.mfb[i] >= 0 else 0) for i, m in enumerate(um)],
+            dtype=np.int32,
+        )
+        return BundleInfo(
+            bundle_of=jnp.asarray(lay.bundle_of),
+            off_lo=jnp.asarray(lay.off_lo),
+            mfb=jnp.asarray(lay.mfb),
+            expand_idx=jnp.asarray(self.bundle_expand),
+            width=jnp.asarray(width),
+        )
+
+    @property
+    def col_bins(self) -> int:
+        """Uniform device bin-axis size of the stored columns."""
+        if self.bundle_layout is not None:
+            return max(self.bundle_layout.col_bins, self.max_num_bin)
+        return self.max_num_bin
 
     def padded(self, arr: Optional[np.ndarray], fill: float = 0.0, dtype=np.float32) -> np.ndarray:
         """Pad a per-row array to num_rows_padded."""

@@ -1,0 +1,71 @@
+"""Device-side EFB bundle support for the growers.
+
+The bin matrix on device holds one column per BUNDLE (bundling.py);
+split finding and partitioning still speak per-feature. Two traced
+helpers bridge the gap:
+
+- `expand_hist`: bundle histogram (3, G, Bc) -> per-feature histogram
+  (3, F, Bf) by gather, recovering each merged feature's most-frequent
+  bin from the leaf totals (the reference FixHistogram,
+  include/LightGBM/dataset.h:768 — same trick, same reason: the
+  most-frequent bin is not stored).
+- `decode_feature_bins`: bundle column values -> original bins of one
+  feature (used by the partition step in place of a direct column read).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BundleInfo(NamedTuple):
+    """Traced bundle arrays (built host-side in dataset.py)."""
+
+    bundle_of: jax.Array  # (F,) int32 — device column per feature
+    off_lo: jax.Array  # (F,) int32 — merged-range start (0 for direct)
+    mfb: jax.Array  # (F,) int32 — excluded most-freq bin; -1 = direct
+    expand_idx: jax.Array  # (F, Bf) int32 — flat (G*Bc) index or -1
+    width: jax.Array  # (F,) int32 — merged-range length (num_bin - 1)
+
+
+def expand_hist(hist_g: jax.Array, g: jax.Array, h: jax.Array, c: jax.Array,
+                binfo: BundleInfo) -> jax.Array:
+    """(3, G, Bc) bundle histogram -> (3, F, Bf) per-feature histogram.
+
+    g/h/c are the leaf totals used to recover the most-frequent slot:
+    hist[f, mfb] = total - sum(stored bins of f).
+    """
+    F, Bf = binfo.expand_idx.shape
+    flat = hist_g.reshape(3, -1)
+    safe = jnp.clip(binfo.expand_idx, 0, flat.shape[1] - 1)
+    out = jnp.take(flat, safe.reshape(-1), axis=1).reshape(3, F, Bf)
+    out = jnp.where(binfo.expand_idx[None] >= 0, out, 0.0)
+    has_mfb = binfo.mfb >= 0
+    totals = jnp.stack([g, h, c]).astype(jnp.float32)  # (3,)
+    missing = totals[:, None] - jnp.sum(out, axis=2)  # (3, F)
+    onehot = (
+        (jnp.arange(Bf, dtype=jnp.int32)[None, :] == binfo.mfb[:, None])
+        & has_mfb[:, None]
+    )  # (F, Bf)
+    return out + onehot[None].astype(jnp.float32) * missing[:, :, None]
+
+
+def decode_feature_bins(bcol: jax.Array, f: jax.Array,
+                        binfo: BundleInfo) -> jax.Array:
+    """Bundle-column values -> feature f's original bins.
+
+    Direct columns (mfb == -1) pass through unchanged; merged features
+    map their range [off_lo, off_lo + width) back (re-inserting the
+    skipped most-frequent slot) and everything else to mfb. `f` may be
+    a scalar or a per-row vector matching bcol (all ops elementwise) —
+    the single home of this decode; keep traversal/partition callers on
+    it."""
+    m = binfo.mfb[f]
+    lo = binfo.off_lo[f]
+    t = bcol - lo
+    in_range = (t >= 0) & (t < binfo.width[f])
+    decoded = jnp.where(in_range, t + (t >= m), m)
+    return jnp.where(m >= 0, decoded, bcol)

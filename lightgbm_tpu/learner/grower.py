@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .bundle import BundleInfo, decode_feature_bins, expand_hist
 from .histogram import (
     build_gh8,
     gather_gh8,
@@ -64,6 +65,31 @@ class GrowerSpec(NamedTuple):
     # (permuted.py — the production path); "flat": per-row leaf-id vector,
     # O(N) per split (kept as the reference/debug implementation)
     partition: str = "permuted"
+    # EFB (dataset.cpp:111 FindGroups): the bin matrix columns are
+    # BUNDLES; histograms expand back to per-feature layout before split
+    # finding and the partition decodes original bins (bundle.py).
+    # col_bins = uniform device bin-axis size of the bundle columns
+    # (>= num_bins); 0 means same as num_bins.
+    efb: bool = False
+    col_bins: int = 0
+    # round-batched growth (permuted partition only, opt-in via
+    # tpu_growth_rounds): split EVERY positive-gain leaf per step while
+    # the budget allows — one stable sort partitions all leaves, one
+    # multi-slot histogram pass covers all smaller children (the
+    # reference CUDA kernel's all-leaves batching,
+    # cuda_histogram_constructor.cu). NOT identical to sequential
+    # leaf-wise greedy once the leaf budget binds: greedy may spend the
+    # remaining budget on descendants of high-gain splits instead of
+    # sibling leaves (best-first vs breadth-batched). Default off; the
+    # sequential path is the reference-exact semantics.
+    rounds: bool = False
+    # voting parallel (tree_learner=voting, parallel_tree_learner.h:126):
+    # each shard proposes its top-k features by LOCAL gain, a global
+    # vote elects ~2k, and only elected feature columns are psum'd
+    # across the mesh — the reference's bandwidth cap, applied to the
+    # DCN-scale case (within one ICI slice a full psum is cheap and
+    # tree_learner=data is the better choice). 0 = off.
+    voting_k: int = 0
 
 
 class TreeArrays(NamedTuple):
@@ -206,6 +232,7 @@ def grow_tree(
     params: SplitParams,
     spec: GrowerSpec,
     valid: Optional[jax.Array] = None,  # (N,) f32 — 1 for real rows; None = all
+    bundle: Optional[BundleInfo] = None,
 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (tree arrays, per-row leaf assignment).
 
@@ -217,11 +244,11 @@ def grow_tree(
 
         return grow_tree_permuted(
             bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-            feat_mask, params, spec, valid
+            feat_mask, params, spec, valid, bundle
         )
     return _grow_tree_flat(
         bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-        feat_mask, params, spec, valid
+        feat_mask, params, spec, valid, bundle
     )
 
 
@@ -239,6 +266,7 @@ def _grow_tree_flat(
     params: SplitParams,
     spec: GrowerSpec,
     valid: Optional[jax.Array] = None,
+    bundle: Optional[BundleInfo] = None,
 ) -> Tuple[TreeArrays, jax.Array]:
     """Flat row->leaf-id formulation (cuda_data_partition.cu style).
 
@@ -249,22 +277,30 @@ def _grow_tree_flat(
     """
     L = spec.num_leaves
     B = spec.num_bins
-    F, N = bins_fm.shape
+    G, N = bins_fm.shape  # G = device columns (bundles when spec.efb)
     ax = spec.axis_name
     caps = hist_capacities(N)
+    Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
+
+    def exp_hist(h, g_sum, h_sum, c_sum):
+        """Bundle-space histogram -> per-feature for the split scan."""
+        if spec.efb:
+            return expand_hist(h, g_sum, h_sum, c_sum, bundle)
+        return h
 
     gh8 = build_gh8(grad * mask, hess * mask, mask)  # (8, N)
     root = root_sums(gh8, ax)
 
-    hist0 = histogram(bins_fm, gh8, B)
+    hist0 = histogram(bins_fm, gh8, Bc)
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
     root_out = leaf_output(root[0], root[1], params)
-    rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin,
+    rec0 = best_split(exp_hist(hist0, root[0], root[1], root[2]),
+                      root[0], root[1], root[2], num_bins, nan_bin,
                       mono, is_cat, params, feat_mask,
                       cat_subset=spec.cat_subset, parent_output=root_out)
 
-    hist = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
+    hist = jnp.zeros((L, 3, G, Bc), jnp.float32).at[0].set(hist0)
     best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
 
     tree = TreeArrays(
@@ -357,7 +393,10 @@ def _grow_tree_flat(
 
         # ---- partition: update per-row leaf ids (cuda_data_partition.cu) ----
         f = rec.feature
-        fbins = lax.dynamic_slice_in_dim(bins_fm, f, 1, axis=0).reshape(N)
+        col = bundle.bundle_of[f] if spec.efb else f
+        fbins = lax.dynamic_slice_in_dim(bins_fm, col, 1, axis=0).reshape(N)
+        if spec.efb:
+            fbins = decode_feature_bins(fbins, f, bundle)
         fnan = nan_bin[f]
         go_left = jnp.where(
             rec.is_cat,
@@ -390,9 +429,9 @@ def _grow_tree_flat(
             def mk_branch(cap: int):
                 def branch(_):
                     idx = jnp.nonzero(on_small, size=cap, fill_value=N)[0]
-                    bb = gather_rows(bins_fm, idx)  # (F, cap)
+                    bb = gather_rows(bins_fm, idx)  # (G, cap)
                     gg = gather_gh8(gh8, idx)  # (8, cap)
-                    return histogram(bb, gg, B)
+                    return histogram(bb, gg, Bc)
 
                 return branch
 
@@ -410,7 +449,7 @@ def _grow_tree_flat(
             small_hist = lax.switch(bidx, branches, None)
         else:
             on_small_f = (row_leaf == small_id).astype(gh8.dtype)
-            small_hist = histogram(bins_fm, gh8 * on_small_f[None, :], B)
+            small_hist = histogram(bins_fm, gh8 * on_small_f[None, :], Bc)
         if ax is not None:
             small_hist = lax.psum(small_hist, ax)
         large_hist = parent_hist - small_hist
@@ -419,11 +458,13 @@ def _grow_tree_flat(
         hist = s.hist.at[l].set(left_hist).at[new].set(right_hist)
 
         # ---- best splits for both children ----
-        bl = best_split(left_hist, rec.left_g, rec.left_h, rec.left_c,
+        bl = best_split(exp_hist(left_hist, rec.left_g, rec.left_h, rec.left_c),
+                        rec.left_g, rec.left_h, rec.left_c,
                         num_bins, nan_bin, mono, is_cat, params, feat_mask,
                         cat_subset=spec.cat_subset, parent_output=lo,
                         cmin=lmin, cmax=lmax)
-        br = best_split(right_hist, rec.right_g, rec.right_h, rec.right_c,
+        br = best_split(exp_hist(right_hist, rec.right_g, rec.right_h, rec.right_c),
+                        rec.right_g, rec.right_h, rec.right_c,
                         num_bins, nan_bin, mono, is_cat, params, feat_mask,
                         cat_subset=spec.cat_subset, parent_output=ro,
                         cmin=rmin, cmax=rmax)

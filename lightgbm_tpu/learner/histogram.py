@@ -99,6 +99,45 @@ def histogram(bins_fm: jax.Array, gh8: jax.Array, num_bins: int) -> jax.Array:
     return _hist_fallback(bins_fm, gh8, num_bins)
 
 
+def hist_slots(
+    bins_fm: jax.Array,
+    gh8: jax.Array,
+    begins: jax.Array,
+    counts: jax.Array,
+    num_bins: int,
+    num_slots: int,
+    dense_visits: bool = False,
+) -> jax.Array:
+    """Per-slot histograms over contiguous row segments -> (S, 3, F, B).
+
+    One data pass for ALL slots (the multi-leaf batched construction of
+    the reference CUDA histogram kernel, cuda_histogram_constructor.cu —
+    there one block per leaf, here one visit plan over sorted segments).
+    Slots with counts == 0 return zeros. `dense_visits` doubles the
+    visit budget for sharded runs where local segments can exceed N/2.
+    """
+    F, N = bins_fm.shape
+    if _use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK:
+        from .pallas_hist import hist_slots_tpu
+
+        out = hist_slots_tpu(
+            bins_fm, gh8, begins, counts, num_bins, num_slots,
+            dense_visits=dense_visits,
+        )  # (S+1, CH, F*B)
+        out3 = jnp.stack(
+            [out[:, 0] + out[:, 1], out[:, 2] + out[:, 3], out[:, 4]], axis=1
+        ).reshape(num_slots + 1, 3, F, num_bins)[:num_slots]
+        return jnp.where((counts > 0)[:, None, None, None], out3, 0.0)
+
+    iota = jnp.arange(N, dtype=jnp.int32)
+
+    def one(b, c):
+        m = ((iota >= b) & (iota < b + c)).astype(jnp.float32)
+        return _hist_fallback(bins_fm, gh8 * m[None, :], num_bins)
+
+    return jax.vmap(one)(begins, counts)
+
+
 def gather_rows(bins_fm: jax.Array, idx: jax.Array) -> jax.Array:
     """Gather rows (lane axis) by index -> (F, len(idx)). Out-of-range
     idx (pad slots) fill with bin 0; callers zero their gh so those rows

@@ -58,6 +58,115 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, acc_ref, *, F: int, B: int, blk: int
         out_ref[...] = acc_ref[...]
 
 
+def _hist_slots_kernel(
+    vblock_ref, vslot_ref, vlo_ref, vhi_ref,  # scalar prefetch
+    bins_ref, gh_ref, out_ref, acc_ref, *, F: int, B: int, blk: int
+):
+    """One visit = (row block, slot, in-block row range). Visits arrive
+    sorted by slot; acc accumulates a slot's histogram across its visits
+    and flushes to the slot's output block on the slot's last visit."""
+    v = pl.program_id(0)
+    slot = vslot_ref[v]
+    prev_slot = vslot_ref[jnp.maximum(v - 1, 0)]
+
+    @pl.when((v == 0) | (slot != prev_slot))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    lo = vlo_ref[v]
+    hi = vhi_ref[v]
+    iota_r = lax.broadcasted_iota(jnp.int32, (CH, blk), 1)
+    g = jnp.where((iota_r >= lo) & (iota_r < hi), gh_ref[...], 0.0).astype(
+        jnp.bfloat16
+    )
+    bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
+    iota = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
+    for f in range(F):
+        onehot = (bt[:, f : f + 1] == iota).astype(jnp.bfloat16)  # (blk, B)
+        acc_ref[:, f * B : (f + 1) * B] += jnp.dot(
+            g, onehot, preferred_element_type=jnp.float32
+        )
+
+    # vslot has a trailing sentinel, so v+1 is always readable
+    @pl.when(vslot_ref[v + 1] != slot)
+    def _flush():
+        out_ref[...] = acc_ref[...][None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "num_slots", "blk", "dense_visits")
+)
+def hist_slots_tpu(
+    bins_fm: jax.Array,  # (F, N) int32, rows POSITION-grouped by slot
+    gh8: jax.Array,  # (CH, N) f32
+    begins: jax.Array,  # (num_slots,) int32 — slot segment starts
+    counts: jax.Array,  # (num_slots,) int32 — slot segment lengths
+    num_bins: int,
+    num_slots: int,
+    blk: int = HIST_BLK,
+    dense_visits: bool = False,
+) -> jax.Array:
+    """Per-slot histograms in ONE data pass: (num_slots+1, CH, F*B).
+
+    Each slot is a contiguous row segment [begin, begin+count); segments
+    must be disjoint but need not cover all rows (total visited blocks
+    is bounded by nb//2 + 2*num_slots — callers use this for the
+    smaller-children of one round, whose total is <= N/2). The +1 slot
+    is a trash row absorbing padding visits; slot s of the output is
+    garbage when counts[s] == 0 AND no visit wrote it — callers must
+    mask by counts > 0.
+    """
+    F, N = bins_fm.shape
+    assert N % blk == 0, (N, blk)
+    B = num_bins
+    nb = N // blk
+    S = num_slots
+    # visit budget: sum(counts) <= N/2 (smaller children) + 2 boundary
+    # blocks per slot; sharded runs can exceed N/2 locally -> dense
+    V = (nb if dense_visits else nb // 2) + 2 * S + 2
+
+    cnt1 = jnp.maximum(counts, 1)  # empty slots still get one zero visit
+    blk0 = begins // blk
+    blk1 = (begins + cnt1 - 1) // blk
+    nblk = jnp.clip(blk1 - blk0 + 1, 1, nb)
+    offs = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(nblk)])
+    iota_v = jnp.arange(V, dtype=jnp.int32)
+    s_of_v = (
+        jnp.searchsorted(offs, iota_v, side="right").astype(jnp.int32) - 1
+    )
+    pad = s_of_v >= S
+    s_clip = jnp.clip(s_of_v, 0, S - 1)
+    vblock = jnp.clip(
+        blk0[s_clip] + iota_v - offs[s_clip], 0, nb - 1
+    ).astype(jnp.int32)
+    bstart = vblock * blk
+    vlo = jnp.clip(begins[s_clip] - bstart, 0, blk)
+    vhi = jnp.clip(begins[s_clip] + counts[s_clip] - bstart, 0, blk)
+    vslot = jnp.where(pad, S, s_of_v).astype(jnp.int32)
+    vlo = jnp.where(pad, 0, vlo).astype(jnp.int32)
+    vhi = jnp.where(pad, 0, vhi).astype(jnp.int32)
+    vslot_s = jnp.concatenate([vslot, jnp.full(1, S + 1, jnp.int32)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(V,),
+        in_specs=[
+            pl.BlockSpec((F, blk), lambda v, vb, vs, lo, hi: (0, vb[v])),
+            pl.BlockSpec((CH, blk), lambda v, vb, vs, lo, hi: (0, vb[v])),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, CH, F * B), lambda v, vb, vs, lo, hi: (vs[v], 0, 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((CH, F * B), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_hist_slots_kernel, F=F, B=B, blk=blk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S + 1, CH, F * B), jnp.float32),
+    )(vblock, vslot_s, vlo, vhi, bins_fm, gh8)
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins", "blk"))
 def hist_tpu(
     bins_fm: jax.Array, gh8: jax.Array, num_bins: int, blk: int = HIST_BLK

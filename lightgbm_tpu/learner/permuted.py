@@ -36,8 +36,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .histogram import HIST_BLK, build_gh8, histogram, root_sums
-from .split import BIG, NEG_INF, SplitParams, SplitRecord, best_split, leaf_output
+from .bundle import BundleInfo, decode_feature_bins, expand_hist
+from .histogram import HIST_BLK, build_gh8, hist_slots, histogram, root_sums
+from .split import (
+    BIG,
+    NEG_INF,
+    SplitParams,
+    SplitRecord,
+    best_split,
+    feature_best_gains,
+    leaf_output,
+)
 from .grower import (
     GrowerSpec,
     TreeArrays,
@@ -78,6 +87,19 @@ class _PState(NamedTuple):
     leaf_max: jax.Array
     best: SplitRecord
     tree: TreeArrays
+    # (L, F) bool — features whose stored histogram holds GLOBAL sums.
+    # Always all-True except under voting (spec.voting_k > 0), where
+    # only elected features are reduced across the mesh
+    # (voting_parallel_tree_learner.cpp: global hists exist only for
+    # elected features); subtraction and search respect this mask.
+    hist_valid: jax.Array
+
+
+class _RState(NamedTuple):
+    """Round-phase state: _PState plus an explicit row -> leaf vector."""
+
+    p: _PState
+    pleaf: jax.Array  # (N,) int32; padding rows carry L (sorts last)
 
 
 def _go_left(fbins, rec, fnan):
@@ -86,6 +108,28 @@ def _go_left(fbins, rec, fnan):
         rec.cat_mask[fbins],
         (fbins <= rec.bin) | (rec.default_left & (fbins == fnan) & (fnan >= 0)),
     )
+
+
+def _excl_prefix(x: jax.Array, blk: int = 512) -> jax.Array:
+    """(N,) f32 -> (N+1,) exclusive prefix sums.
+
+    Two-level: strict-upper-triangular matmul for in-block prefixes
+    (MXU, f32-exact for counts < 2^24) + a tiny cumsum over block
+    totals — a plain 1M-element jnp.cumsum measured ~47 ms on TPU,
+    this is ~1 GFLOP of matmul instead.
+    """
+    n = x.shape[0]
+    nb2 = n // blk
+    if nb2 * blk != n:  # fall back for odd sizes (CPU tests)
+        cs = jnp.cumsum(x)
+        return jnp.concatenate([jnp.zeros(1, x.dtype), cs])
+    xb = x.reshape(nb2, blk)
+    upper = jnp.triu(jnp.ones((blk, blk), jnp.float32), 1)
+    intra = jnp.dot(xb, upper, preferred_element_type=jnp.float32)
+    tot = jnp.sum(xb, axis=1)
+    boff = jnp.concatenate([jnp.zeros(1, jnp.float32), jnp.cumsum(tot)])
+    p = (intra + boff[:-1, None]).reshape(n)
+    return jnp.concatenate([p, boff[-1:]])
 
 
 @partial(jax.jit, static_argnames=("spec",))
@@ -102,26 +146,38 @@ def grow_tree_permuted(
     params: SplitParams,
     spec: GrowerSpec,
     valid: Optional[jax.Array] = None,
+    bundle: Optional[BundleInfo] = None,
 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (tree arrays, natural-order row->leaf)."""
     L = spec.num_leaves
     B = spec.num_bins
-    F, N = bins_fm.shape
+    G, N = bins_fm.shape  # G = device columns (bundles when spec.efb)
+    F = num_bins.shape[0]  # original features
     ax = spec.axis_name
     caps = segment_caps(N)
+    Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
+    if spec.voting_k and spec.efb:
+        raise ValueError("voting_k requires EFB off (feature==column)")
+
+    def exp_hist(h, g_sum, h_sum, c_sum):
+        """Bundle-space histogram -> per-feature for the split scan."""
+        if spec.efb:
+            return expand_hist(h, g_sum, h_sum, c_sum, bundle)
+        return h
 
     gh8 = build_gh8(grad * mask, hess * mask, mask)  # (8, N)
     root = root_sums(gh8, ax)
 
-    hist0 = histogram(bins_fm, gh8, B)
+    hist0 = histogram(bins_fm, gh8, Bc)
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
     root_out = leaf_output(root[0], root[1], params)
-    rec0 = best_split(hist0, root[0], root[1], root[2], num_bins, nan_bin,
+    rec0 = best_split(exp_hist(hist0, root[0], root[1], root[2]),
+                      root[0], root[1], root[2], num_bins, nan_bin,
                       mono, is_cat, params, feat_mask,
                       cat_subset=spec.cat_subset, parent_output=root_out)
 
-    hist = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
+    hist = jnp.zeros((L, 3, G, Bc), jnp.float32).at[0].set(hist0)
     best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
 
     tree = TreeArrays(
@@ -146,6 +202,244 @@ def grow_tree_permuted(
     valid_f = jnp.ones(N, jnp.float32) if valid is None else valid
     n_valid = jnp.sum(valid_f > 0).astype(jnp.int32)  # local (shard) count
 
+    iota_L = jnp.arange(L, dtype=jnp.int32)
+    S = L // 2 + 1  # max splits per round (budget guard caps at L/2)
+
+    def _round_body(rs: _RState) -> _RState:
+        """Split EVERY positive-gain leaf at once (multi-leaf batch)."""
+        s = rs.p
+        t = s.tree
+        i = s.i
+        mask = s.best.gain > 0.0  # (L,)
+        n_split = jnp.sum(mask).astype(jnp.int32)
+        rank = jnp.cumsum(mask.astype(jnp.int32)) - mask  # exclusive
+        rank = jnp.minimum(rank, S - 1)
+        node_id = i + rank  # node slot per split leaf
+        new_id = i + 1 + rank  # right-child leaf id per split leaf
+        drop_node = jnp.where(mask, node_id, L - 1)  # L-1 -> mode=drop
+        drop_new = jnp.where(mask, new_id, L)
+
+        rec = s.best  # per-leaf records, fields (L,)
+
+        # ---- outputs / monotone intervals, vectorized over leaves ----
+        pmin, pmax = s.leaf_min, s.leaf_max
+        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset,
+                                    t.leaf_value, pmin, pmax)
+        lmin, lmax, rmin, rmax = monotone_child_intervals(
+            rec, mono, lo, ro, pmin, pmax
+        )
+        depth_new = t.leaf_depth + 1
+
+        # ---- tree bookkeeping (Tree::Split, batched) ----
+        p = s.leaf_parent
+        pc = jnp.maximum(p, 0)
+        p_is_left = t.node_left[pc] == ~iota_L
+        fix = mask & (p >= 0)
+        node_left = t.node_left.at[
+            jnp.where(fix & p_is_left, pc, L - 1)
+        ].set(node_id, mode="drop")
+        node_right = t.node_right.at[
+            jnp.where(fix & ~p_is_left, pc, L - 1)
+        ].set(node_id, mode="drop")
+        node_left = node_left.at[drop_node].set(~iota_L, mode="drop")
+        node_right = node_right.at[drop_node].set(~drop_new, mode="drop")
+
+        tree_new = TreeArrays(
+            num_nodes=i + n_split,
+            node_feature=t.node_feature.at[drop_node].set(rec.feature, mode="drop"),
+            node_bin=t.node_bin.at[drop_node].set(rec.bin, mode="drop"),
+            node_gain=t.node_gain.at[drop_node].set(rec.gain, mode="drop"),
+            node_default_left=t.node_default_left.at[drop_node].set(
+                rec.default_left, mode="drop"
+            ),
+            node_cat=t.node_cat.at[drop_node].set(rec.is_cat, mode="drop"),
+            node_cat_mask=t.node_cat_mask.at[drop_node].set(
+                rec.cat_mask, mode="drop"
+            ),
+            node_left=node_left,
+            node_right=node_right,
+            node_value=t.node_value.at[drop_node].set(t.leaf_value, mode="drop"),
+            node_weight=t.node_weight.at[drop_node].set(s.leaf_h, mode="drop"),
+            node_count=t.node_count.at[drop_node].set(s.leaf_c, mode="drop"),
+            leaf_value=jnp.where(mask, lo, t.leaf_value)
+            .at[drop_new].set(ro, mode="drop"),
+            leaf_weight=jnp.where(mask, rec.left_h, t.leaf_weight)
+            .at[drop_new].set(rec.right_h, mode="drop"),
+            leaf_count=jnp.where(mask, rec.left_c, t.leaf_count)
+            .at[drop_new].set(rec.right_c, mode="drop"),
+            leaf_depth=jnp.where(mask, depth_new, t.leaf_depth)
+            .at[drop_new].set(depth_new, mode="drop"),
+        )
+
+        # ---- per-row split decision for ALL leaves at once ----
+        pl_c = jnp.minimum(rs.pleaf, L - 1)  # padding rows -> dead lanes
+        f_row = rec.feature[pl_c]
+        col_row = bundle.bundle_of[f_row] if spec.efb else f_row
+        # masked select of each row's split column (no 2D gather)
+        sel = col_row[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]
+        fbins = jnp.sum(jnp.where(sel, s.pbins, 0), axis=0)
+        if spec.efb:
+            fbins = decode_feature_bins(fbins, f_row, bundle)  # vector f
+        fnan_row = nan_bin[f_row]
+        cat_hit = rec.cat_mask.reshape(-1)[pl_c * B + jnp.minimum(fbins, B - 1)]
+        go_left = jnp.where(
+            rec.is_cat[pl_c],
+            cat_hit,
+            (fbins <= rec.bin[pl_c])
+            | (rec.default_left[pl_c] & (fbins == fnan_row) & (fnan_row >= 0)),
+        )
+        in_split = mask[pl_c] & (rs.pleaf < L)
+        pleaf_new = jnp.where(
+            in_split & ~go_left, new_id[pl_c], rs.pleaf
+        ).astype(jnp.int32)
+
+        # ---- stable multi-leaf partition WITHOUT a sort (XLA TPU sort
+        # is seconds at 1M rows): per-row destination = segment start +
+        # stable rank within the destination child, via two-level
+        # prefix sums; then one scatter to invert the permutation and
+        # one gather to apply it to all channels.
+        gl_in = in_split & go_left
+        gr_in = in_split & ~go_left
+        P_l = _excl_prefix(gl_in.astype(jnp.float32))  # (N+1,)
+        P_r = _excl_prefix(gr_in.astype(jnp.float32))
+        beg = s.seg_begin
+        endp = jnp.minimum(beg + s.seg_count, N)
+        n_l = (P_l[endp] - P_l[jnp.minimum(beg, N)]).astype(jnp.int32)
+        n_l = jnp.where(mask, n_l, 0)
+
+        pos = jnp.arange(N, dtype=jnp.int32)
+        b_row = beg[pl_c]
+        Pl_b = P_l[jnp.minimum(b_row, N)]
+        Pr_b = P_r[jnp.minimum(b_row, N)]
+        dst_l = b_row + (P_l[:-1] - Pl_b).astype(jnp.int32)
+        dst_r = b_row + n_l[pl_c] + (P_r[:-1] - Pr_b).astype(jnp.int32)
+        dst = jnp.where(gl_in, dst_l, jnp.where(gr_in, dst_r, pos))
+        inv = jnp.zeros(N, jnp.int32).at[dst].set(pos)
+        pbins = jnp.take(s.pbins, inv, axis=1)
+        pgh = jnp.take(s.pgh, inv, axis=1)
+        pperm = s.pperm[inv]
+        pleaf_s = pleaf_new[inv]
+        n_r = jnp.where(mask, s.seg_count - n_l, 0)
+        if ax is not None:
+            gn_l = lax.psum(n_l, ax)
+            gn_r = lax.psum(n_r, ax)
+        else:
+            gn_l, gn_r = n_l, n_r
+        left_smaller = gn_l <= gn_r  # (L,)
+
+        seg_begin = s.seg_begin.at[drop_new].set(
+            s.seg_begin + n_l, mode="drop"
+        )
+        seg_count = jnp.where(mask, n_l, s.seg_count).at[drop_new].set(
+            n_r, mode="drop"
+        )
+
+        # ---- multi-slot histograms for all smaller children ----
+        sm_begin_leaf = jnp.where(left_smaller, s.seg_begin, s.seg_begin + n_l)
+        sm_cnt_leaf = jnp.where(left_smaller, n_l, n_r)
+        slot_of = jnp.where(mask, rank, S)
+        slot_begin = jnp.zeros(S, jnp.int32).at[slot_of].set(
+            sm_begin_leaf, mode="drop"
+        )
+        slot_cnt = jnp.zeros(S, jnp.int32).at[slot_of].set(
+            sm_cnt_leaf, mode="drop"
+        )
+        slot_hists = hist_slots(
+            pbins, pgh, slot_begin, slot_cnt, Bc, S,
+            dense_visits=ax is not None,
+        )  # (S, 3, G, Bc)
+        if ax is not None:
+            slot_hists = lax.psum(slot_hists, ax)
+
+        # ---- per-leaf child hists: smaller from slots, larger by
+        # subtraction; write both into the pool
+        small_leaf = slot_hists[jnp.minimum(rank, S - 1)]  # (L, 3, G, Bc)
+        large_leaf = s.hist - small_leaf
+        left_h_ = jnp.where(
+            left_smaller[:, None, None, None], small_leaf, large_leaf
+        )
+        right_h_ = jnp.where(
+            left_smaller[:, None, None, None], large_leaf, small_leaf
+        )
+        hist = jnp.where(mask[:, None, None, None], left_h_, s.hist)
+        hist = hist.at[drop_new].set(right_h_, mode="drop")
+
+        # ---- best splits for all 2*n_split children, batched ----
+        def child_best(h, g_, h__, c_, po, cmn, cmx):
+            return best_split(
+                exp_hist(h, g_, h__, c_), g_, h__, c_, num_bins, nan_bin,
+                mono, is_cat, params, feat_mask,
+                cat_subset=spec.cat_subset, parent_output=po,
+                cmin=cmn, cmax=cmx,
+            )
+
+        vbest = jax.vmap(child_best)
+        ch_hist = jnp.concatenate([left_h_, right_h_])  # (2L, 3, G, Bc)
+        ch_g = jnp.concatenate([rec.left_g, rec.right_g])
+        ch_h = jnp.concatenate([rec.left_h, rec.right_h])
+        ch_c = jnp.concatenate([rec.left_c, rec.right_c])
+        ch_po = jnp.concatenate([lo, ro])
+        ch_mn = jnp.concatenate([lmin, rmin])
+        ch_mx = jnp.concatenate([lmax, rmax])
+        ch_rec = vbest(ch_hist, ch_g, ch_h, ch_c, ch_po, ch_mn, ch_mx)
+        depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
+        ch_gain = jnp.where(
+            jnp.concatenate([depth_ok, depth_ok]), ch_rec.gain, NEG_INF
+        )
+        ch_leaf = jnp.concatenate([jnp.where(mask, iota_L, L), drop_new])
+
+        def scat(dst, val):
+            return dst.at[ch_leaf].set(val, mode="drop")
+
+        best2 = SplitRecord(
+            gain=scat(s.best.gain, ch_gain),
+            feature=scat(s.best.feature, ch_rec.feature),
+            bin=scat(s.best.bin, ch_rec.bin),
+            default_left=scat(s.best.default_left, ch_rec.default_left),
+            is_cat=scat(s.best.is_cat, ch_rec.is_cat),
+            cat_mask=scat(s.best.cat_mask, ch_rec.cat_mask),
+            left_g=scat(s.best.left_g, ch_rec.left_g),
+            left_h=scat(s.best.left_h, ch_rec.left_h),
+            left_c=scat(s.best.left_c, ch_rec.left_c),
+            right_g=scat(s.best.right_g, ch_rec.right_g),
+            right_h=scat(s.best.right_h, ch_rec.right_h),
+            right_c=scat(s.best.right_c, ch_rec.right_c),
+        )
+
+        p_new = _PState(
+            i=i + n_split,
+            pbins=pbins,
+            pgh=pgh,
+            pperm=pperm,
+            seg_begin=seg_begin,
+            seg_count=seg_count,
+            hist=hist,
+            leaf_g=jnp.where(mask, rec.left_g, s.leaf_g)
+            .at[drop_new].set(rec.right_g, mode="drop"),
+            leaf_h=jnp.where(mask, rec.left_h, s.leaf_h)
+            .at[drop_new].set(rec.right_h, mode="drop"),
+            leaf_c=jnp.where(mask, rec.left_c, s.leaf_c)
+            .at[drop_new].set(rec.right_c, mode="drop"),
+            leaf_parent=jnp.where(mask, node_id, s.leaf_parent)
+            .at[drop_new].set(node_id, mode="drop"),
+            leaf_min=jnp.where(mask, lmin, s.leaf_min)
+            .at[drop_new].set(rmin, mode="drop"),
+            leaf_max=jnp.where(mask, lmax, s.leaf_max)
+            .at[drop_new].set(rmax, mode="drop"),
+            best=best2,
+            tree=tree_new,
+            hist_valid=s.hist_valid,
+        )
+        return _RState(p=p_new, pleaf=pleaf_s)
+
+    def _round_cond(rs: _RState) -> jax.Array:
+        mask = rs.p.best.gain > 0.0
+        n_split = jnp.sum(mask)
+        # budget guard: after splitting every positive-gain leaf the
+        # leaf count stays within num_leaves — identical to sequential
+        # greedy (which would also split exactly these leaves)
+        return (n_split > 0) & (rs.p.i + 1 + n_split <= L)
+
     state = _PState(
         i=jnp.int32(0),
         pbins=bins_fm,
@@ -162,7 +456,16 @@ def grow_tree_permuted(
         leaf_max=jnp.full(L, BIG, jnp.float32),
         best=best,
         tree=tree,
+        hist_valid=jnp.ones((L, F), bool),
     )
+
+    if spec.rounds and L > 2:
+        rstate = _RState(
+            p=state,
+            pleaf=jnp.where(valid_f > 0, 0, L).astype(jnp.int32),
+        )
+        rstate = lax.while_loop(_round_cond, _round_body, rstate)
+        state = rstate.p
 
     def cond(s: _PState) -> jax.Array:
         return (s.i < L - 1) & (jnp.max(s.best.gain) > 0.0)
@@ -217,20 +520,26 @@ def grow_tree_permuted(
         b = s.seg_begin[l]
         c = s.seg_count[l]
         fnan = nan_bin[rec.feature]
+        fcol_idx = bundle.bundle_of[rec.feature] if spec.efb else rec.feature
 
         # ---- stable partition of segment [b, b+c) at capacity cap ----
+        # (XLA TPU sort is NOT an option here: a 1M-row multi-payload
+        # stable sort measured 0.3-2s with minutes of per-shape compile
+        # on this backend — nonzero+gather it is.)
         def mk_part(cap: int):
             def part(_):
                 start = jnp.clip(b, 0, N - cap)
                 off = b - start
-                sbins = lax.dynamic_slice(s.pbins, (jnp.int32(0), start), (F, cap))
+                sbins = lax.dynamic_slice(s.pbins, (jnp.int32(0), start), (G, cap))
                 sgh = lax.dynamic_slice(s.pgh, (jnp.int32(0), start), (8, cap))
                 sperm = lax.dynamic_slice(s.pperm, (start,), (cap,))
                 iota = jnp.arange(cap, dtype=jnp.int32)
                 in_seg = (iota >= off) & (iota < off + c)
                 fcol = lax.dynamic_slice(
-                    sbins, (rec.feature, jnp.int32(0)), (1, cap)
+                    sbins, (fcol_idx, jnp.int32(0)), (1, cap)
                 ).reshape(cap)
+                if spec.efb:
+                    fcol = decode_feature_bins(fcol, rec.feature, bundle)
                 gl = _go_left(fcol, rec, fnan)
                 sel_l = in_seg & gl
                 n_l = jnp.sum(sel_l).astype(jnp.int32)
@@ -278,18 +587,49 @@ def grow_tree_permuted(
             def h(_):
                 start = jnp.clip(small_begin, 0, N - cap)
                 off = small_begin - start
-                hb = lax.dynamic_slice(pbins, (jnp.int32(0), start), (F, cap))
+                hb = lax.dynamic_slice(pbins, (jnp.int32(0), start), (G, cap))
                 hg = lax.dynamic_slice(pgh, (jnp.int32(0), start), (8, cap))
                 iota = jnp.arange(cap, dtype=jnp.int32)
                 m = ((iota >= off) & (iota < off + small_cnt)).astype(jnp.float32)
-                return histogram(hb, hg * m[None, :], B)
+                return histogram(hb, hg * m[None, :], Bc)
 
             return h
 
         hidx = jnp.clip(jnp.sum(caps_arr >= small_cnt) - 1, 0, len(caps) - 1)
         small_hist = lax.switch(hidx, [mk_hist(cp) for cp in caps], None)
-        if ax is not None:
-            small_hist = lax.psum(small_hist, ax)
+        valid_parent = s.hist_valid[l]  # (F,)
+        if spec.voting_k and ax is not None:
+            # ---- voting election (GlobalVoting, parallel_tree_learner
+            # .h:152): each shard proposes its top-k features by LOCAL
+            # gain on the smaller child; votes + summed gains elect 2k;
+            # only elected columns cross the mesh
+            k = min(spec.voting_k, F)
+            k2 = min(2 * spec.voting_k, F)
+            lsums = jnp.sum(small_hist[:, 0, :], axis=-1)  # (3,) local
+            lgains = feature_best_gains(
+                small_hist, lsums[0], lsums[1], lsums[2], num_bins,
+                nan_bin, mono, is_cat, params, feat_mask,
+                cat_subset=spec.cat_subset,
+            )
+            _, topi = lax.top_k(lgains, k)
+            in_topk = jnp.zeros(F, bool).at[topi].set(True)
+            votes = lax.psum(in_topk.astype(jnp.float32), ax)
+            score = lax.psum(
+                jnp.where(in_topk, jnp.maximum(lgains, 0.0), 0.0), ax
+            )
+            _, eidx = lax.top_k(votes * 1e12 + score, k2)
+            elected = jnp.zeros(F, bool).at[eidx].set(True)
+            comp = lax.psum(small_hist[:, eidx, :], ax)  # (3, 2k, B) wire
+            small_hist = (
+                jnp.zeros_like(small_hist).at[:, eidx, :].set(comp)
+            )
+            valid_small = elected
+            valid_large = elected & valid_parent
+        else:
+            if ax is not None:
+                small_hist = lax.psum(small_hist, ax)
+            valid_small = valid_parent
+            valid_large = valid_parent
 
         parent_hist = s.hist[l]
         large_hist = parent_hist - small_hist
@@ -298,12 +638,25 @@ def grow_tree_permuted(
         hist = s.hist.at[l].set(left_hist).at[new].set(right_hist)
 
         # ---- best splits for both children ----
-        bl = best_split(left_hist, rec.left_g, rec.left_h, rec.left_c,
-                        num_bins, nan_bin, mono, is_cat, params, feat_mask,
+        if spec.voting_k:
+            valid_left = jnp.where(left_smaller, valid_small, valid_large)
+            valid_right = jnp.where(left_smaller, valid_large, valid_small)
+            fm_l = feat_mask & valid_left
+            fm_r = feat_mask & valid_right
+            hist_valid = s.hist_valid.at[l].set(valid_left).at[new].set(
+                valid_right
+            )
+        else:
+            fm_l = fm_r = feat_mask
+            hist_valid = s.hist_valid
+        bl = best_split(exp_hist(left_hist, rec.left_g, rec.left_h, rec.left_c),
+                        rec.left_g, rec.left_h, rec.left_c,
+                        num_bins, nan_bin, mono, is_cat, params, fm_l,
                         cat_subset=spec.cat_subset, parent_output=lo,
                         cmin=lmin, cmax=lmax)
-        br = best_split(right_hist, rec.right_g, rec.right_h, rec.right_c,
-                        num_bins, nan_bin, mono, is_cat, params, feat_mask,
+        br = best_split(exp_hist(right_hist, rec.right_g, rec.right_h, rec.right_c),
+                        rec.right_g, rec.right_h, rec.right_c,
+                        num_bins, nan_bin, mono, is_cat, params, fm_r,
                         cat_subset=spec.cat_subset, parent_output=ro,
                         cmin=rmin, cmax=rmax)
         depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
@@ -326,6 +679,7 @@ def grow_tree_permuted(
             leaf_max=s.leaf_max.at[l].set(lmax).at[new].set(rmax),
             best=best2,
             tree=tree_new,
+            hist_valid=hist_valid,
         )
 
     final = lax.while_loop(cond, body, state)

@@ -259,6 +259,32 @@ def best_split(
     cmax: jax.Array = BIG,
 ) -> SplitRecord:
     """Find the best split of a leaf with given histogram and totals."""
+    return _best_split_impl(
+        hist, sum_g, sum_h, sum_c, num_bins, nan_bin, mono, is_cat, params,
+        feat_mask, cat_subset, parent_output, cmin, cmax,
+    )[0]
+
+
+def feature_best_gains(
+    hist, sum_g, sum_h, sum_c, num_bins, nan_bin, mono, is_cat, params,
+    feat_mask=None, cat_subset: bool = False, parent_output=0.0,
+    cmin=-BIG, cmax=BIG,
+):
+    """Per-feature best (shifted) gain: max over thresholds/directions.
+
+    The local-gain vote of the voting-parallel learner
+    (voting_parallel_tree_learner.cpp:353 local top-k proposals) —
+    computed on the LOCAL (un-reduced) histogram."""
+    return _best_split_impl(
+        hist, sum_g, sum_h, sum_c, num_bins, nan_bin, mono, is_cat, params,
+        feat_mask, cat_subset, parent_output, cmin, cmax,
+    )[1]
+
+
+def _best_split_impl(
+    hist, sum_g, sum_h, sum_c, num_bins, nan_bin, mono, is_cat, params,
+    feat_mask, cat_subset, parent_output, cmin, cmax,
+):
     _, F, B = hist.shape
     g = hist[0]
     h = hist[1]
@@ -385,7 +411,7 @@ def best_split(
         ) & valid_bin[f]
         cat_mask = jnp.where(is_sub, sub_mask, cat_mask)
 
-    return SplitRecord(
+    rec = SplitRecord(
         gain=best_gain,
         feature=f,
         bin=b,
@@ -399,3 +425,4 @@ def best_split(
         right_h=sum_h - lh,
         right_c=sum_c - lc,
     )
+    return rec, jnp.max(gains, axis=(1, 2))

@@ -54,17 +54,17 @@ class DataParallelGrower:
         bins_spec = P(None, axis_name)  # bins are (F, N): rows on axis 1
         rep = P()
 
-        def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params, valid):
+        def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params, valid, bundle):
             tree, row_leaf = grow_tree(
                 bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-                feat_mask, params, self.spec, valid=valid,
+                feat_mask, params, self.spec, valid=valid, bundle=bundle,
             )
             # tree state is identical on all shards (computed from psum'd
             # histograms); mark it replicated for the out_spec
             tree = jax.tree.map(lambda a: jax.lax.pmean(a, axis_name) if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
             return tree, row_leaf
 
-        in_specs = (bins_spec, rep, rep, rep, rep, row, row, row, rep, rep, row)
+        in_specs = (bins_spec, rep, rep, rep, rep, row, row, row, rep, rep, row, rep)
         out_specs = (jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)), row)
         self._fn = jax.jit(
             jax.shard_map(
@@ -77,10 +77,11 @@ class DataParallelGrower:
         )
 
     def __call__(self, bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-                 feat_mask, params: SplitParams, valid) -> Tuple[TreeArrays, jax.Array]:
+                 feat_mask, params: SplitParams, valid, bundle=None,
+                 ) -> Tuple[TreeArrays, jax.Array]:
         return self._fn(
             bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask,
-            params, valid,
+            params, valid, bundle,
         )
 
     def shard_inputs(self, dev: dict) -> dict:
@@ -107,6 +108,8 @@ class DataParallelGrower:
         out["valid"] = jax.device_put(dev["valid"], row)
         for k in ("nan_bin", "num_bins", "mono", "is_cat"):
             out[k] = jax.device_put(dev[k], rep)
+        if dev.get("bundle") is not None:
+            out["bundle"] = jax.device_put(dev["bundle"], rep)
         return out
 
 

@@ -324,36 +324,59 @@ def tree_to_arrays(t: Tree, dataset: "BinnedDataset") -> "TreeArrays":
     )
 
 
-def traverse_tree_bins(arrays: "TreeArrays", bins_fm, nan_bin):
+def traverse_tree_bins(arrays: "TreeArrays", bins_fm, nan_bin, bundle=None):
     """Device traversal of a grown tree over a BINNED matrix -> per-row leaf.
 
     Used to score validation sets each iteration (reference
-    ScoreUpdater::AddScore via tree traversal). Iterates node-by-node like
-    the training partition: O(num_nodes) masked passes, all regular ops.
+    ScoreUpdater::AddScore via tree traversal). DEPTH-stepped: every row
+    advances one level per pass, so the loop runs tree-depth times (not
+    num_nodes times — 254 sequential passes at 255 leaves would dominate
+    the fused iteration). Per pass, each row's split-feature bins are
+    materialized with a masked select over the feature axis — regular
+    vector ops, no per-row 2D gather. With `bundle` (EFB datasets) the
+    matrix columns are bundles, decoded per row from small per-feature
+    tables.
     """
     import jax.numpy as jnp
     from jax import lax
 
-    F, N = bins_fm.shape
+    G, N = bins_fm.shape
     n_nodes = arrays.num_nodes
+    max_nodes = arrays.node_feature.shape[0]
 
-    def body(k, row_node):
-        # rows sitting at internal node k move to a child
-        f = arrays.node_feature[k]
-        fbins = lax.dynamic_slice_in_dim(bins_fm, f, 1, axis=0).reshape(N)
+    def cond(s):
+        it, row_node = s
+        return (it < max_nodes) & jnp.any(row_node >= 0)
+
+    def body(s):
+        it, row_node = s
+        k = jnp.maximum(row_node, 0)  # clamp: leaf rows produce dead lanes
+        f = arrays.node_feature[k]  # (N,) gather from a <=L-1 table
+        col = f if bundle is None else bundle.bundle_of[f]
+        # masked select of each row's split-feature bin over the column
+        # axis: sum of G per-column selects (VPU), no 2D gather
+        sel = col[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]  # (G, N)
+        fbins = jnp.sum(jnp.where(sel, bins_fm, 0), axis=0)
+        if bundle is not None:
+            from .learner.bundle import decode_feature_bins
+
+            fbins = decode_feature_bins(fbins, f, bundle)  # vector f
         fnan = nan_bin[f]
+        B = arrays.node_cat_mask.shape[1]
+        cat_hit = arrays.node_cat_mask.reshape(-1)[k * B + fbins]
         go_left = jnp.where(
             arrays.node_cat[k],
-            arrays.node_cat_mask[k][fbins],
+            cat_hit,
             (fbins <= arrays.node_bin[k])
             | (arrays.node_default_left[k] & (fbins == fnan) & (fnan >= 0)),
         )
-        on = row_node == k
         child = jnp.where(go_left, arrays.node_left[k], arrays.node_right[k])
-        return jnp.where(on & (k < n_nodes), child, row_node)
+        at_internal = (row_node >= 0) & (row_node < n_nodes)
+        row_node = jnp.where(at_internal, child, row_node)
+        return it + 1, row_node
 
-    row_node = jnp.zeros(N, jnp.int32)
-    row_node = lax.fori_loop(0, arrays.node_feature.shape[0], body, row_node)
-    # all rows should now be at leaves (negative); a stump stays at node 0
+    row_node = jnp.where(n_nodes > 0, 0, -1) * jnp.ones(N, jnp.int32)
+    _, row_node = lax.while_loop(cond, body, (jnp.int32(0), row_node))
+    # all rows now at leaves (negative); a stump stays at node 0
     leaf = jnp.where(row_node < 0, ~row_node, 0)
     return leaf

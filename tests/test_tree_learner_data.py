@@ -98,3 +98,48 @@ def test_data_parallel_multiclass():
     ps, pd = b_serial.predict(X[:100]), b_data.predict(X[:100])
     np.testing.assert_allclose(pd, ps, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(pd.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_voting_parallel_trains():
+    """tree_learner=voting: top-k election restricts the search and the
+    psum payload; trees differ from tree_learner=data only by the
+    election approximation (voting_parallel_tree_learner.cpp)."""
+    from sklearn.metrics import roc_auc_score
+
+    X, y = _binary_problem(n=4096, f=12, seed=9)
+    b_vote = _train({**BASE, "tree_learner": "voting", "top_k": 4}, X, y)
+    assert b_vote.num_trees() == 15
+    auc = roc_auc_score(y, b_vote.predict(X))
+    assert auc > 0.9
+
+    # with top_k >= num_features the election is a no-op: identical to
+    # tree_learner=data
+    b_vote_full = _train({**BASE, "tree_learner": "voting", "top_k": 12}, X, y)
+    b_data = _train({**BASE, "tree_learner": "data"}, X, y)
+    np.testing.assert_allclose(
+        b_vote_full.predict(X), b_data.predict(X), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_rounds_and_efb_on_mesh():
+    """Round-batched growth and EFB under shard_map: the rounds-body
+    psums (global child counts, slot histograms) and the dense_visits
+    slot budget only execute on a mesh — cover them here."""
+    # sparse blocks so EFB actually bundles
+    rs = np.random.RandomState(13)
+    n = 4096
+    Xs = np.zeros((n, 9))
+    idx = rs.randint(0, 9, n)
+    on = rs.rand(n) < 0.5
+    Xs[np.arange(n)[on], idx[on]] = rs.rand(int(on.sum())) + 0.5
+    Xd = rs.randn(n, 3)
+    X = np.hstack([Xd, Xs])
+    y = ((X[:, 0] + Xs.sum(1) + 0.3 * rs.randn(n)) > 0.7).astype(np.float64)
+    serial = _train({**BASE, "tpu_growth_rounds": True}, X, y, rounds=8)
+    mesh = _train(
+        {**BASE, "tree_learner": "data", "tpu_growth_rounds": True}, X, y,
+        rounds=8,
+    )
+    np.testing.assert_allclose(
+        mesh.predict(X), serial.predict(X), rtol=1e-4, atol=1e-5
+    )

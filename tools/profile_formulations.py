@@ -1,0 +1,79 @@
+"""Compare grower formulations on the real device: permuted vs flat.
+
+Times ONE grow_tree call (after warmup) for each formulation at
+1M x 28 / 255 leaves — isolates the grower from objective/metric/eval.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import BinnedDataset
+from lightgbm_tpu.learner import GrowerSpec, grow_tree, make_split_params
+
+ROWS = int(os.environ.get("P_ROWS", 1_000_000))
+FEATS = int(os.environ.get("P_FEATS", 28))
+LEAVES = int(os.environ.get("P_LEAVES", 255))
+REPS = int(os.environ.get("P_REPS", 3))
+
+rs = np.random.RandomState(7)
+X = rs.randn(ROWS, FEATS).astype(np.float32)
+y = (X[:, 0] + rs.randn(ROWS) > 0).astype(np.float32)
+
+cfg = Config({"objective": "binary", "num_leaves": LEAVES, "max_bin": 255})
+ds = BinnedDataset.from_numpy(X, cfg, label=y)
+d = ds.device_arrays()
+N = ds.num_rows_padded()
+F = ds.num_used_features
+
+grad = jnp.asarray(rs.randn(N).astype(np.float32)) * d["valid"]
+hess = jnp.ones(N, jnp.float32) * d["valid"]
+mask = d["valid"]
+feat_mask = jnp.ones(F, bool)
+params = make_split_params(cfg)
+
+print(f"platform={jax.devices()[0].platform} N={N} F={F} B={ds.max_num_bin}")
+
+variants = [
+    ("permuted", dict(partition="permuted")),
+    ("flat_gather", dict(partition="flat", gather_hist=True)),
+    ("flat_masked", dict(partition="flat", gather_hist=False)),
+]
+sel = os.environ.get("P_VARIANTS")
+if sel:
+    variants = [v for v in variants if v[0] in sel.split(",")]
+
+for name, kw in variants:
+    spec = GrowerSpec(
+        num_leaves=LEAVES, num_bins=ds.max_num_bin, max_depth=-1, **kw
+    )
+    t0 = time.time()
+    tree, row_leaf = grow_tree(
+        d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+        grad, hess, mask, feat_mask, params, spec, valid=d["valid"],
+    )
+    jax.block_until_ready(row_leaf)
+    compile_s = time.time() - t0
+    times = []
+    for r in range(REPS):
+        t0 = time.time()
+        tree, row_leaf = grow_tree(
+            d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+            grad, hess, mask, feat_mask, params, spec, valid=d["valid"],
+        )
+        jax.block_until_ready(row_leaf)
+        times.append(time.time() - t0)
+    nn = int(tree.num_nodes)
+    print(
+        f"{name:12s} compile+1st={compile_s:7.2f}s "
+        f"steady={min(times):7.3f}s/tree nodes={nn}",
+        flush=True,
+    )
