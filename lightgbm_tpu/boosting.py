@@ -120,6 +120,7 @@ class GBDT:
         # reduction (identical results to "data" by construction).
         self._mesh = None
         self._dp = None
+        self._parallel_mode = None  # None | "data" | "feature"
         import jax
 
         n_dev = jax.device_count()
@@ -135,15 +136,28 @@ class GBDT:
                     "(voting_parallel_tree_learner.cpp semantics)"
                 )
             self._mesh = make_mesh()
+            self._parallel_mode = "data"
             blk = HIST_BLK
             if HIST_BLK % n_dev != 0 or jax.devices()[0].platform == "tpu":
                 blk = HIST_BLK * n_dev  # per-shard rows stay pallas-aligned
             train_set.ensure_row_block(blk)
         elif config.tree_learner == "feature" and n_dev > 1:
-            log.warning(
-                "tree_learner=feature is not implemented on the TPU mesh "
-                "yet; falling back to serial (single-device) growth"
-            )
+            if train_set.bundle_layout is not None:
+                log.warning(
+                    "tree_learner=feature requires EFB off (feature == "
+                    "column); falling back to serial growth. Set "
+                    "enable_bundle=false."
+                )
+            else:
+                from .parallel.data_parallel import make_mesh
+
+                self._mesh = make_mesh(axis_name="feature")
+                self._parallel_mode = "feature"
+                log.info(
+                    f"tree_learner=feature: {len(train_set.used_features)} "
+                    f"features sharded over {n_dev} devices "
+                    "(feature_parallel_tree_learner.cpp semantics)"
+                )
         # objective/strategy init AFTER ensure_row_block: they cache
         # padded per-row arrays and must see the final row padding
         if self.objective is not None:
@@ -176,11 +190,12 @@ class GBDT:
             num_leaves=config.num_leaves,
             num_bins=train_set.max_num_bin,
             max_depth=config.max_depth,
-            axis_name="data" if self._mesh is not None else None,
+            axis_name="data" if self._parallel_mode == "data" else None,
             cat_subset=cat_subset,
             efb=train_set.bundle_layout is not None,
             col_bins=train_set.col_bins,
-            rounds=config.tpu_growth_rounds and not use_voting,
+            rounds=(config.tpu_growth_rounds and not use_voting
+                    and self._parallel_mode != "feature"),
             voting_k=config.top_k if use_voting else 0,
         )
         self.params = make_split_params(config)
@@ -199,7 +214,7 @@ class GBDT:
         self._label_dev = (
             jnp.asarray(train_set.padded(meta.label)) if meta.label is not None else None
         )
-        if self._mesh is not None:
+        if self._parallel_mode == "data":
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from .parallel.data_parallel import DataParallelGrower
@@ -215,6 +230,12 @@ class GBDT:
                 self._label_dev = jax.device_put(
                     self._label_dev, NamedSharding(self._mesh, P("data"))
                 )
+        elif self._parallel_mode == "feature":
+            from .parallel.feature_parallel import FeatureParallelGrower
+
+            self._dp = FeatureParallelGrower(self._mesh, self.spec)
+            self.dev = self._dp.shard_inputs(self.dev)
+            train_set.invalidate_device_cache()
 
     # ------------------------------------------------------------------
     def _renewal_setup(self):

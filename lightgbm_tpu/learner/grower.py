@@ -83,6 +83,13 @@ class GrowerSpec(NamedTuple):
     # sibling leaves (best-first vs breadth-batched). Default off; the
     # sequential path is the reference-exact semantics.
     rounds: bool = False
+    # feature parallel (tree_learner=feature, parallel_tree_learner.h:26):
+    # the FLAT grower with the FEATURE axis sharded over this mesh axis —
+    # every shard holds all rows (the reference's all-ranks-hold-all-data
+    # design), finds the best split among its own features, and the
+    # global best is an all-gather argmax (SyncUpGlobalBestSplit); the
+    # winning shard broadcasts the per-row split decision with one psum.
+    feature_axis: Optional[str] = None
     # voting parallel (tree_learner=voting, parallel_tree_learner.h:126):
     # each shard proposes its top-k features by LOCAL gain, a global
     # vote elects ~2k, and only elected feature columns are psum'd
@@ -282,6 +289,30 @@ def _grow_tree_flat(
     caps = hist_capacities(N)
     Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
 
+    fax = spec.feature_axis
+    if fax is not None:
+        if spec.efb or ax is not None:
+            raise ValueError("feature_axis excludes EFB and a data axis")
+        my_off = lax.axis_index(fax) * G
+        # replicated global tables for winner-record lookups (tiny)
+        num_bins_g = lax.all_gather(num_bins, fax).reshape(-1)
+        mono_g = lax.all_gather(mono, fax).reshape(-1)
+    else:
+        my_off = 0
+        num_bins_g, mono_g = num_bins, mono
+
+    def select_global(rec: SplitRecord) -> SplitRecord:
+        """All-gather each shard's best and keep the max-gain one
+        (reference SyncUpGlobalBestSplit allreduce-max,
+        parallel_tree_learner.h:209; ties resolve to the lowest shard =
+        lowest global feature block)."""
+        if fax is None:
+            return rec
+        rec = rec._replace(feature=rec.feature + my_off)
+        stacked = jax.tree.map(lambda a: lax.all_gather(a, fax), rec)
+        w = jnp.argmax(stacked.gain)
+        return jax.tree.map(lambda a: a[w], stacked)
+
     def exp_hist(h, g_sum, h_sum, c_sum):
         """Bundle-space histogram -> per-feature for the split scan."""
         if spec.efb:
@@ -295,10 +326,11 @@ def _grow_tree_flat(
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
     root_out = leaf_output(root[0], root[1], params)
-    rec0 = best_split(exp_hist(hist0, root[0], root[1], root[2]),
-                      root[0], root[1], root[2], num_bins, nan_bin,
-                      mono, is_cat, params, feat_mask,
-                      cat_subset=spec.cat_subset, parent_output=root_out)
+    rec0 = select_global(
+        best_split(exp_hist(hist0, root[0], root[1], root[2]),
+                   root[0], root[1], root[2], num_bins, nan_bin,
+                   mono, is_cat, params, feat_mask,
+                   cat_subset=spec.cat_subset, parent_output=root_out))
 
     hist = jnp.zeros((L, 3, G, Bc), jnp.float32).at[0].set(hist0)
     best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
@@ -365,10 +397,10 @@ def _grow_tree_flat(
         node_right = node_right.at[i].set(~new)
 
         pmin, pmax = s.leaf_min[l], s.leaf_max[l]
-        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset,
+        lo, ro = split_leaf_outputs(rec, params, num_bins_g, spec.cat_subset,
                                     t.leaf_value[l], pmin, pmax)
         lmin, lmax, rmin, rmax = monotone_child_intervals(
-            rec, mono, lo, ro, pmin, pmax
+            rec, mono_g, lo, ro, pmin, pmax
         )
         depth_new = t.leaf_depth[l] + 1
 
@@ -392,17 +424,34 @@ def _grow_tree_flat(
         )
 
         # ---- partition: update per-row leaf ids (cuda_data_partition.cu) ----
-        f = rec.feature
-        col = bundle.bundle_of[f] if spec.efb else f
-        fbins = lax.dynamic_slice_in_dim(bins_fm, col, 1, axis=0).reshape(N)
-        if spec.efb:
-            fbins = decode_feature_bins(fbins, f, bundle)
-        fnan = nan_bin[f]
-        go_left = jnp.where(
-            rec.is_cat,
-            rec.cat_mask[fbins],
-            (fbins <= rec.bin) | (rec.default_left & (fbins == fnan) & (fnan >= 0)),
-        )
+        f = rec.feature  # GLOBAL feature id under feature_axis
+        if fax is not None:
+            f_loc = jnp.clip(f - my_off, 0, G - 1)
+            fbins = lax.dynamic_slice_in_dim(bins_fm, f_loc, 1, axis=0).reshape(N)
+            fnan = nan_bin[f_loc]
+            gl = jnp.where(
+                rec.is_cat,
+                rec.cat_mask[fbins],
+                (fbins <= rec.bin)
+                | (rec.default_left & (fbins == fnan) & (fnan >= 0)),
+            )
+            mine = (f >= my_off) & (f < my_off + G)
+            # only the owning shard's decision counts; broadcast it
+            go_left = lax.psum(
+                jnp.where(mine, gl, False).astype(jnp.int32), fax
+            ) > 0
+        else:
+            col = bundle.bundle_of[f] if spec.efb else f
+            fbins = lax.dynamic_slice_in_dim(bins_fm, col, 1, axis=0).reshape(N)
+            if spec.efb:
+                fbins = decode_feature_bins(fbins, f, bundle)
+            fnan = nan_bin[f]
+            go_left = jnp.where(
+                rec.is_cat,
+                rec.cat_mask[fbins],
+                (fbins <= rec.bin)
+                | (rec.default_left & (fbins == fnan) & (fnan >= 0)),
+            )
         on_leaf = s.row_leaf == l
         row_leaf = jnp.where(on_leaf & ~go_left, new, s.row_leaf)
 
@@ -458,16 +507,18 @@ def _grow_tree_flat(
         hist = s.hist.at[l].set(left_hist).at[new].set(right_hist)
 
         # ---- best splits for both children ----
-        bl = best_split(exp_hist(left_hist, rec.left_g, rec.left_h, rec.left_c),
-                        rec.left_g, rec.left_h, rec.left_c,
-                        num_bins, nan_bin, mono, is_cat, params, feat_mask,
-                        cat_subset=spec.cat_subset, parent_output=lo,
-                        cmin=lmin, cmax=lmax)
-        br = best_split(exp_hist(right_hist, rec.right_g, rec.right_h, rec.right_c),
-                        rec.right_g, rec.right_h, rec.right_c,
-                        num_bins, nan_bin, mono, is_cat, params, feat_mask,
-                        cat_subset=spec.cat_subset, parent_output=ro,
-                        cmin=rmin, cmax=rmax)
+        bl = select_global(best_split(
+            exp_hist(left_hist, rec.left_g, rec.left_h, rec.left_c),
+            rec.left_g, rec.left_h, rec.left_c,
+            num_bins, nan_bin, mono, is_cat, params, feat_mask,
+            cat_subset=spec.cat_subset, parent_output=lo,
+            cmin=lmin, cmax=lmax))
+        br = select_global(best_split(
+            exp_hist(right_hist, rec.right_g, rec.right_h, rec.right_c),
+            rec.right_g, rec.right_h, rec.right_c,
+            num_bins, nan_bin, mono, is_cat, params, feat_mask,
+            cat_subset=spec.cat_subset, parent_output=ro,
+            cmin=rmin, cmax=rmax))
         depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
         best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
         best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))

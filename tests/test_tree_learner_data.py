@@ -143,3 +143,18 @@ def test_rounds_and_efb_on_mesh():
     np.testing.assert_allclose(
         mesh.predict(X), serial.predict(X), rtol=1e-4, atol=1e-5
     )
+
+
+def test_feature_parallel_matches_serial():
+    """tree_learner=feature: features sharded over the mesh, every
+    device holds all rows; the all-gathered winner records must
+    reproduce serial trees exactly (feature_parallel_tree_learner.cpp:
+    all ranks hold all data, so results equal serial by construction)."""
+    X, y = _binary_problem(n=2048, f=10, seed=21)
+    params = {**BASE, "enable_bundle": False}
+    b_serial = _train(params, X, y, rounds=8)
+    b_feat = _train({**params, "tree_learner": "feature"}, X, y, rounds=8)
+    assert b_feat.num_trees() == b_serial.num_trees()
+    np.testing.assert_allclose(
+        b_feat.predict(X), b_serial.predict(X), rtol=1e-4, atol=1e-5
+    )
