@@ -293,9 +293,21 @@ class Booster:
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError(f"Training data should be Dataset instance, met {type(train_set).__name__}")
+            # params relevant to dataset CONSTRUCTION merge into the
+            # dataset (binding at first construct); the booster's config
+            # takes only dataset-relevant keys from the dataset so one
+            # training's params never leak into the next booster using
+            # the same Dataset
+            from .config import DATASET_PARAMS, resolve_alias
+
             train_set.params = {**train_set.params, **self.params}
             train_set.construct()
-            self.config = Config(train_set.params)
+            ds_part = {
+                k: v
+                for k, v in train_set.params.items()
+                if resolve_alias(k) in DATASET_PARAMS
+            }
+            self.config = Config({**ds_part, **self.params})
             from .boosting import create_boosting
 
             self._gbdt = create_boosting(self.config, train_set._binned)
@@ -461,6 +473,13 @@ class Booster:
         if pred_leaf:
             return self._gbdt.predict_leaf_index(arr, start_iteration, num_iteration)
         if pred_contrib:
+            if any(t.is_linear for t in self._gbdt.models):
+                from . import log
+
+                log.fatal(
+                    "pred_contrib (SHAP) is not supported for models "
+                    "with linear trees"
+                )
             return self._gbdt.predict_contrib(arr, start_iteration, num_iteration)
         # prediction early stop (reference c_api predict parameter
         # parsing; kwargs mirror the parameter names)

@@ -186,6 +186,82 @@ class GBDT:
                 "histogram psum (tree_learner=data). Set "
                 "enable_bundle=false to use the voting election."
             )
+        # ---- per-node extras: extra_trees, feature_fraction_bynode,
+        # interaction constraints, CEGB (permuted sequential path only)
+        from .config import parse_interaction_constraints
+
+        groups = parse_interaction_constraints(
+            config.interaction_constraints, len(train_set.mappers)
+        )
+        self._group_mat = None
+        n_groups = 0
+        if groups:
+            used_pos = {int(f): i for i, f in enumerate(train_set.used_features)}
+            gm = np.zeros((len(groups), len(train_set.used_features)), bool)
+            for gi, gr in enumerate(groups):
+                for f in gr:
+                    if f in used_pos:
+                        gm[gi, used_pos[f]] = True
+            self._group_mat = jnp.asarray(gm)
+            n_groups = len(groups)
+        self._cegb_info = None
+        use_cegb = (
+            config.cegb_penalty_split > 0.0
+            or len(config.cegb_penalty_feature_coupled) > 0
+            or len(config.cegb_penalty_feature_lazy) > 0
+        )
+        if use_cegb:
+            from .learner.grower import CegbInfo
+
+            fu = len(train_set.used_features)
+
+            def _pen(t):
+                if not t:
+                    return np.zeros(fu, np.float32)
+                if len(t) != len(train_set.mappers):
+                    log.fatal(
+                        "cegb_penalty_feature_* must have one entry per feature"
+                    )
+                return np.asarray(
+                    [t[int(f)] for f in train_set.used_features], np.float32
+                )
+
+            self._cegb_info = CegbInfo(
+                coupled=jnp.asarray(_pen(config.cegb_penalty_feature_coupled)),
+                lazy=jnp.asarray(_pen(config.cegb_penalty_feature_lazy)),
+                used=jnp.zeros(fu, bool),
+            )
+            if len(config.cegb_penalty_feature_coupled) > 0:
+                # coupled costs are charged once per feature MODEL-WIDE
+                # (is_feature_used_in_split_); the fused loop cannot see
+                # cross-iteration feature usage, so run synchronously
+                self._force_sync = True
+        if config.linear_tree:
+            # leaf ridge fits run host-side per iteration (the reference
+            # solves with Eigen on CPU too, linear_tree_learner.cpp:344)
+            self._force_sync = True
+            if train_set.raw_data is None:
+                log.fatal(
+                    "linear_tree requires raw feature values; construct "
+                    "the Dataset with linear_tree in its params"
+                )
+        use_extra = config.extra_trees
+        use_bynode = config.feature_fraction_bynode < 1.0
+        if (use_extra or use_bynode or use_cegb or n_groups) and (
+            self._parallel_mode == "feature"
+        ):
+            log.warning(
+                "extra_trees / feature_fraction_bynode / cegb / interaction"
+                "_constraints are not supported with tree_learner=feature; "
+                "ignoring them"
+            )
+            use_extra = use_bynode = use_cegb = False
+            n_groups = 0
+            self._cegb_info = self._group_mat = None
+        self._node_key = (
+            jax.random.key(config.extra_seed) if (use_extra or use_bynode)
+            else None
+        )
         self.spec = GrowerSpec(
             num_leaves=config.num_leaves,
             num_bins=train_set.max_num_bin,
@@ -195,8 +271,13 @@ class GBDT:
             efb=train_set.bundle_layout is not None,
             col_bins=train_set.col_bins,
             rounds=(config.tpu_growth_rounds and not use_voting
-                    and self._parallel_mode != "feature"),
+                    and self._parallel_mode != "feature"
+                    and not (use_extra or use_bynode or use_cegb or n_groups)),
             voting_k=config.top_k if use_voting else 0,
+            extra_trees=use_extra,
+            ff_bynode=use_bynode,
+            cegb=use_cegb,
+            n_groups=n_groups,
         )
         self.params = make_split_params(config)
         self.train = _ScoreSet(
@@ -277,9 +358,9 @@ class GBDT:
         with the true gradients afterward."""
         c = self.config
         if not c.use_quantized_grad:
-            return self._grow(gk, hk, mask, feat_mask, valid)
+            return self._grow(gk, hk, mask, feat_mask, valid, it, k)
         gq, hq = self._quantize(gk, hk, it, k)
-        arrays, row_leaf = self._grow(gq, hq, mask, feat_mask, valid)
+        arrays, row_leaf = self._grow(gq, hq, mask, feat_mask, valid, it, k)
         if c.quant_train_renew_leaf:
             if self._quant_renew_ok:
                 from .learner.quantize import renew_leaf_with_true_gradients
@@ -306,22 +387,30 @@ class GBDT:
         )
 
     # ------------------------------------------------------------------
-    def _grow(self, gk, hk, mask, feat_mask, valid):
+    def _grow(self, gk, hk, mask, feat_mask, valid, it=0, k=0):
         """Grow one tree on the training set — serial, or sharded over the
         data mesh when tree_learner=data/voting (lockstep trees on every
         shard, reference data_parallel_tree_learner.cpp). Traceable: used
-        both eagerly and inside the fused jit step."""
+        both eagerly and inside the fused jit step (it may be traced)."""
+        import jax
+
         d = self.dev
+        rng_key = None
+        if self._node_key is not None:
+            rng_key = jax.random.fold_in(
+                self._node_key, it * self.num_class + k
+            )
         if self._dp is not None:
             return self._dp(
                 d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
                 gk, hk, mask, feat_mask, self.params, valid,
-                d.get("bundle"),
+                d.get("bundle"), rng_key, self._group_mat, self._cegb_info,
             )
         return grow_tree(
             d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
             gk, hk, mask, feat_mask, self.params, self.spec, valid=valid,
-            bundle=d.get("bundle"),
+            bundle=d.get("bundle"), rng_key=rng_key,
+            group_mat=self._group_mat, cegb=self._cegb_info,
         )
 
     # ------------------------------------------------------------------
@@ -587,6 +676,13 @@ class GBDT:
             n_nodes = int(arrays.num_nodes)
             if n_nodes > 0:
                 should_continue = True
+                if self._cegb_info is not None:
+                    # charge coupled costs: mark this tree's features used
+                    # model-wide (is_feature_used_in_split_)
+                    used = self._cegb_info.used
+                    nf = np.asarray(arrays.node_feature[:n_nodes])
+                    used = used.at[jnp.asarray(nf)].set(True)
+                    self._cegb_info = self._cegb_info._replace(used=used)
                 if (
                     self.objective is not None
                     and self.objective.is_renew_tree_output
@@ -598,21 +694,57 @@ class GBDT:
                 final_leaf = arrays.leaf_value * self.shrinkage_rate
                 arrays = arrays._replace(leaf_value=final_leaf)
                 one = jnp.float32(1.0)
-                self.train.score = self.train.score.at[k].set(
-                    add_score(self.train.score[k], row_leaf, final_leaf, one)
-                )
-                for vs in self.valids:
-                    vdev = vs.dataset.device_arrays()
-                    leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
-                    vs.score = vs.score.at[k].set(
-                        add_score(vs.score[k], leaf, final_leaf, one)
+                if self.config.linear_tree:
+                    # fit ridge models on each leaf's path features
+                    # (linear_tree_learner.cpp CalculateLinear) and apply
+                    # per-row linear outputs to the scores
+                    from .binning import BinType
+
+                    n = ds.num_data
+                    rl = np.asarray(row_leaf)[:n]
+                    cat_set = {
+                        int(f)
+                        for f in ds.used_features
+                        if ds.mappers[int(f)].bin_type == BinType.CATEGORICAL
+                    }
+                    tree.fit_linear_leaves(
+                        rl, np.asarray(gk)[:n], np.asarray(hk)[:n],
+                        ds.raw_data, cat_set, self.config.linear_lambda,
+                        self.shrinkage_rate,
+                        row_mask=np.asarray(mask)[:n] > 0,
                     )
+                    vals = tree.linear_leaf_outputs(ds.raw_data, rl)
+                    out = np.zeros(ds.num_rows_padded(), np.float32)
+                    out[:n] = vals
+                    self.train.score = self.train.score.at[k].add(
+                        jnp.asarray(out)
+                    )
+                    for vs in self.valids:
+                        vraw = vs.dataset.raw_data
+                        vn = vs.dataset.num_data
+                        vleafs = tree.predict_leaf(vraw)
+                        vvals = tree.linear_leaf_outputs(vraw, vleafs)
+                        vout = np.zeros(vs.dataset.num_rows_padded(), np.float32)
+                        vout[:vn] = vvals
+                        vs.score = vs.score.at[k].add(jnp.asarray(vout))
+                else:
+                    self.train.score = self.train.score.at[k].set(
+                        add_score(self.train.score[k], row_leaf, final_leaf, one)
+                    )
+                    for vs in self.valids:
+                        vdev = vs.dataset.device_arrays()
+                        leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
+                        vs.score = vs.score.at[k].set(
+                            add_score(vs.score[k], leaf, final_leaf, one)
+                        )
                 if abs(init_scores[k]) > 1e-15:
                     # AddBias: the stored tree (host AND device) carries the
                     # boost-from-average bias; the score got it separately at
                     # BoostFromAverage, so score == sum(stored trees) exactly
                     # (matters for DART drops, gbdt.cpp:424-426)
                     tree.leaf_value = tree.leaf_value + init_scores[k]
+                    if tree.is_linear:
+                        tree.leaf_const = tree.leaf_const + init_scores[k]
                     arrays = arrays._replace(
                         leaf_value=arrays.leaf_value + init_scores[k]
                     )
@@ -664,6 +796,10 @@ class GBDT:
         if self._force_sync or self.objective is None:
             return False
         if not getattr(self.objective, "is_device_gradients", True):
+            return False
+        if getattr(self.objective, "has_host_state", False):
+            # e.g. lambdarank position-bias factors: cross-iteration
+            # host-held state the fused trace could not update
             return False
         from .device_metrics import supported_names
 
@@ -1382,6 +1518,13 @@ class RF(GBDT):
                 gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
             )
             n_nodes = int(arrays.num_nodes)
+            if n_nodes > 0 and self._cegb_info is not None:
+                import jax.numpy as jnp
+
+                nf = np.asarray(arrays.node_feature[:n_nodes])
+                self._cegb_info = self._cegb_info._replace(
+                    used=self._cegb_info.used.at[jnp.asarray(nf)].set(True)
+                )
             init_k = self._rf_init_scores[k]
             if n_nodes > 0:
                 if self.objective is not None and self.objective.is_renew_tree_output:

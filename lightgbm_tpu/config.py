@@ -251,6 +251,21 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
     raise AssertionError(f"unknown param type {typ}")
 
 
+# Parameters that bind to the DATASET at construction time (binning /
+# bundling / raw retention). Only these leak from a shared Dataset into
+# later boosters — a booster's own params (objective, extra_trees, ...)
+# must never pollute a Dataset reused by the next training
+# (reference: Dataset params vs Booster params are separate configs).
+DATASET_PARAMS = frozenset({
+    "max_bin", "max_bin_by_feature", "min_data_in_bin",
+    "bin_construct_sample_cnt", "data_random_seed", "use_missing",
+    "zero_as_missing", "enable_bundle", "feature_pre_filter",
+    "categorical_feature", "linear_tree", "tpu_row_block",
+    "monotone_constraints", "header", "label_column", "weight_column",
+    "group_column", "ignore_column", "two_round", "pre_partition",
+})
+
+
 def resolve_alias(key: str) -> str:
     """ParameterAlias::KeyAliasTransform equivalent: alias -> canonical name."""
     k = key.strip().lower()
@@ -386,16 +401,41 @@ class Config:
 # ---------------------------------------------------------------------------
 _UNIMPLEMENTED = (
     # (name, inactive_value, message)
-    ("linear_tree", False, "linear leaf models are not implemented yet"),
-    ("extra_trees", False, "extremely-randomized splits are not implemented yet"),
-    ("feature_fraction_bynode", 1.0, "per-node feature sampling is not implemented yet (per-tree feature_fraction works)"),
-    ("interaction_constraints", "", "interaction constraints are not implemented yet"),
     ("forcedsplits_filename", "", "forced splits are not implemented yet"),
-    ("cegb_penalty_split", 0.0, "cost-effective gradient boosting penalties are not implemented yet"),
-    ("cegb_penalty_feature_lazy", (), "cost-effective gradient boosting penalties are not implemented yet"),
-    ("cegb_penalty_feature_coupled", (), "cost-effective gradient boosting penalties are not implemented yet"),
-    ("lambdarank_position_bias_regularization", 0.0, "position bias debiasing is not implemented yet"),
 )
+
+
+def parse_interaction_constraints(s: str, num_features: int):
+    """Parse the reference's interaction_constraints string
+    ("[0,1,2],[2,3]" — groups of ORIGINAL feature indices; config.h
+    interaction_constraints) into a list of int lists."""
+    s = (s or "").strip()
+    if not s:
+        return []
+    import re
+
+    groups = []
+    for m in re.finditer(r"\[([^\]]*)\]", s):
+        body = m.group(1).strip()
+        if not body:
+            continue
+        idxs = []
+        for tok in body.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            i = int(tok)
+            if i < 0 or i >= num_features:
+                from . import log
+
+                log.fatal(
+                    f"interaction_constraints index {i} out of range "
+                    f"[0, {num_features})"
+                )
+            idxs.append(i)
+        if idxs:
+            groups.append(idxs)
+    return groups
 
 
 def warn_unimplemented(cfg: "Config") -> None:

@@ -97,6 +97,23 @@ class GrowerSpec(NamedTuple):
     # DCN-scale case (within one ICI slice a full psum is cheap and
     # tree_learner=data is the better choice). 0 = off.
     voting_k: int = 0
+    # per-node extras (permuted sequential path only):
+    # extra_trees: one random numerical threshold per feature per node
+    extra_trees: bool = False
+    # feature_fraction_bynode < 1: per-node feature subsample (ColSampler)
+    ff_bynode: bool = False
+    # CEGB penalties active (cost_effective_gradient_boosting.hpp)
+    cegb: bool = False
+    # number of interaction-constraint groups (0 = unconstrained)
+    n_groups: int = 0
+
+
+class CegbInfo(NamedTuple):
+    """Traced CEGB penalty tables (DeltaGain inputs)."""
+
+    coupled: jax.Array  # (F,) — one-time per-feature cost (model-wide)
+    lazy: jax.Array  # (F,) — per-data cost, charged along each path
+    used: jax.Array  # (F,) bool — features already used by earlier trees
 
 
 class TreeArrays(NamedTuple):
@@ -154,6 +171,9 @@ def make_split_params(cfg) -> SplitParams:
         max_cat_threshold=jnp.int32(cfg.max_cat_threshold),
         max_cat_to_onehot=jnp.int32(cfg.max_cat_to_onehot),
         min_data_per_group=f(cfg.min_data_per_group),
+        cegb_tradeoff=f(cfg.cegb_tradeoff),
+        cegb_penalty_split=f(cfg.cegb_penalty_split),
+        feature_fraction_bynode=f(cfg.feature_fraction_bynode),
     )
 
 
@@ -240,6 +260,9 @@ def grow_tree(
     spec: GrowerSpec,
     valid: Optional[jax.Array] = None,  # (N,) f32 — 1 for real rows; None = all
     bundle: Optional[BundleInfo] = None,
+    rng_key: Optional[jax.Array] = None,  # extra_trees / ff_bynode sampling
+    group_mat: Optional[jax.Array] = None,  # (NG, F) bool — interaction groups
+    cegb: Optional[CegbInfo] = None,
 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (tree arrays, per-row leaf assignment).
 
@@ -251,7 +274,12 @@ def grow_tree(
 
         return grow_tree_permuted(
             bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-            feat_mask, params, spec, valid, bundle
+            feat_mask, params, spec, valid, bundle, rng_key, group_mat, cegb
+        )
+    if spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups:
+        raise ValueError(
+            "extra_trees / feature_fraction_bynode / cegb / interaction "
+            "constraints ride the permuted grower only"
         )
     return _grow_tree_flat(
         bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,

@@ -48,6 +48,7 @@ from .split import (
     leaf_output,
 )
 from .grower import (
+    CegbInfo,
     GrowerSpec,
     TreeArrays,
     _empty_best,
@@ -56,6 +57,14 @@ from .grower import (
     monotone_child_intervals,
     split_leaf_outputs,
 )
+
+
+class _Extras(NamedTuple):
+    """Per-node feature bookkeeping (interaction constraints + CEGB)."""
+
+    leaf_groups: jax.Array  # (L, NG) bool — constraint groups still legal
+    path_used: jax.Array  # (L, F) bool — features used on the leaf's path
+    feat_used: jax.Array  # (F,) bool — used anywhere (CEGB coupled)
 
 
 def segment_caps(n_rows: int) -> tuple:
@@ -93,6 +102,7 @@ class _PState(NamedTuple):
     # (voting_parallel_tree_learner.cpp: global hists exist only for
     # elected features); subtraction and search respect this mask.
     hist_valid: jax.Array
+    extra: _Extras
 
 
 class _RState(NamedTuple):
@@ -147,6 +157,9 @@ def grow_tree_permuted(
     spec: GrowerSpec,
     valid: Optional[jax.Array] = None,
     bundle: Optional[BundleInfo] = None,
+    rng_key: Optional[jax.Array] = None,
+    group_mat: Optional[jax.Array] = None,  # (NG, F) bool
+    cegb: Optional[CegbInfo] = None,
 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (tree arrays, natural-order row->leaf)."""
     L = spec.num_leaves
@@ -158,6 +171,56 @@ def grow_tree_permuted(
     Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
     if spec.voting_k and spec.efb:
         raise ValueError("voting_k requires EFB off (feature==column)")
+    per_node = spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups
+    if spec.rounds and per_node:
+        raise ValueError("tpu_growth_rounds excludes per-node extras")
+
+    def node_candidates(salt, child_groups, path_used_child, child_count,
+                        feat_used):
+        """(feat_mask, rand_bin, penalty) for ONE node's split search."""
+        fm = feat_mask
+        rb = None
+        pen = None
+        if spec.n_groups:
+            # features in any still-legal constraint group (ColSampler
+            # interaction filtering)
+            fm = fm & jnp.any(group_mat & child_groups[:, None], axis=0)
+        if spec.ff_bynode:
+            # sample ceil(frac * currently-valid) from the VALID set
+            # (ColSampler samples from used_feature_indices_, so a node
+            # always keeps >= 1 candidate)
+            k1 = jax.random.fold_in(rng_key, 2 * salt)
+            u = jnp.where(fm, jax.random.uniform(k1, (F,)), jnp.inf)
+            n_valid = jnp.sum(fm)
+            n_pick = jnp.maximum(
+                jnp.ceil(
+                    params.feature_fraction_bynode * n_valid
+                ).astype(jnp.int32),
+                1,
+            )
+            rank = jnp.argsort(jnp.argsort(u))
+            fm = fm & (rank < n_pick)
+        if spec.extra_trees:
+            k2 = jax.random.fold_in(rng_key, 2 * salt + 1)
+            u = jax.random.uniform(k2, (F,))
+            n_thr = jnp.maximum(num_bins - 1 - (nan_bin >= 0), 1)
+            rb = jnp.floor(u * n_thr).astype(jnp.int32)
+        if spec.cegb:
+            # DeltaGain (cost_effective_gradient_boosting.hpp:79). The
+            # lazy per-data cost is approximated PER-TREE-PATH: rows are
+            # considered charged for a feature once an ancestor split of
+            # the CURRENT tree used it, whereas the reference keeps a
+            # model-wide per-(row, feature) bitset across trees —
+            # later trees here re-charge rows earlier trees already
+            # acquired (documented deviation; exact tracking would add
+            # an (N, F) cross-iteration carry).
+            pen = params.cegb_tradeoff * (
+                params.cegb_penalty_split * child_count
+                + cegb.coupled * (~feat_used).astype(jnp.float32)
+                + cegb.lazy * child_count
+                * (~path_used_child).astype(jnp.float32)
+            )
+        return fm, rb, pen
 
     def exp_hist(h, g_sum, h_sum, c_sum):
         """Bundle-space histogram -> per-feature for the split scan."""
@@ -172,10 +235,24 @@ def grow_tree_permuted(
     if ax is not None:
         hist0 = lax.psum(hist0, ax)
     root_out = leaf_output(root[0], root[1], params)
+    NG = max(1, spec.n_groups)
+    extra0 = _Extras(
+        leaf_groups=jnp.ones((L, NG), bool),
+        path_used=jnp.zeros((L, F), bool),
+        feat_used=(cegb.used if spec.cegb else jnp.zeros(F, bool)),
+    )
+    if per_node:
+        fm0, rb0, pen0 = node_candidates(
+            jnp.int32(0), extra0.leaf_groups[0], extra0.path_used[0],
+            root[2], extra0.feat_used,
+        )
+    else:
+        fm0, rb0, pen0 = feat_mask, None, None
     rec0 = best_split(exp_hist(hist0, root[0], root[1], root[2]),
                       root[0], root[1], root[2], num_bins, nan_bin,
-                      mono, is_cat, params, feat_mask,
-                      cat_subset=spec.cat_subset, parent_output=root_out)
+                      mono, is_cat, params, fm0,
+                      cat_subset=spec.cat_subset, parent_output=root_out,
+                      penalty=pen0, rand_bin=rb0)
 
     hist = jnp.zeros((L, 3, G, Bc), jnp.float32).at[0].set(hist0)
     best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
@@ -429,6 +506,7 @@ def grow_tree_permuted(
             best=best2,
             tree=tree_new,
             hist_valid=s.hist_valid,
+            extra=s.extra,
         )
         return _RState(p=p_new, pleaf=pleaf_s)
 
@@ -457,6 +535,7 @@ def grow_tree_permuted(
         best=best,
         tree=tree,
         hist_valid=jnp.ones((L, F), bool),
+        extra=extra0,
     )
 
     if spec.rounds and L > 2:
@@ -649,16 +728,44 @@ def grow_tree_permuted(
         else:
             fm_l = fm_r = feat_mask
             hist_valid = s.hist_valid
+        if per_node:
+            f_split = rec.feature
+            onehot_f = jnp.arange(F, dtype=jnp.int32) == f_split
+            child_groups = s.extra.leaf_groups[l]
+            if spec.n_groups:
+                # only groups containing EVERY feature on the path stay
+                # legal (col_sampler.hpp interaction filtering)
+                child_groups = child_groups & group_mat[:, f_split]
+            pu_child = s.extra.path_used[l] | onehot_f
+            feat_used_new = s.extra.feat_used | onehot_f
+            cn_l = node_candidates(2 * i + 1, child_groups, pu_child,
+                                   rec.left_c, feat_used_new)
+            cn_r = node_candidates(2 * i + 2, child_groups, pu_child,
+                                   rec.right_c, feat_used_new)
+            fm_l = fm_l & cn_l[0]
+            fm_r = fm_r & cn_r[0]
+            rb_l, pen_l = cn_l[1], cn_l[2]
+            rb_r, pen_r = cn_r[1], cn_r[2]
+            extra_new = _Extras(
+                leaf_groups=s.extra.leaf_groups.at[l].set(child_groups)
+                .at[new].set(child_groups),
+                path_used=s.extra.path_used.at[l].set(pu_child)
+                .at[new].set(pu_child),
+                feat_used=feat_used_new,
+            )
+        else:
+            rb_l = rb_r = pen_l = pen_r = None
+            extra_new = s.extra
         bl = best_split(exp_hist(left_hist, rec.left_g, rec.left_h, rec.left_c),
                         rec.left_g, rec.left_h, rec.left_c,
                         num_bins, nan_bin, mono, is_cat, params, fm_l,
                         cat_subset=spec.cat_subset, parent_output=lo,
-                        cmin=lmin, cmax=lmax)
+                        cmin=lmin, cmax=lmax, penalty=pen_l, rand_bin=rb_l)
         br = best_split(exp_hist(right_hist, rec.right_g, rec.right_h, rec.right_c),
                         rec.right_g, rec.right_h, rec.right_c,
                         num_bins, nan_bin, mono, is_cat, params, fm_r,
                         cat_subset=spec.cat_subset, parent_output=ro,
-                        cmin=rmin, cmax=rmax)
+                        cmin=rmin, cmax=rmax, penalty=pen_r, rand_bin=rb_r)
         depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
         best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
         best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))
@@ -680,6 +787,7 @@ def grow_tree_permuted(
             best=best2,
             tree=tree_new,
             hist_valid=hist_valid,
+            extra=extra_new,
         )
 
     final = lax.while_loop(cond, body, state)

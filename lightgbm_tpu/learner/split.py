@@ -52,6 +52,11 @@ class SplitParams(NamedTuple):
     max_cat_threshold: jax.Array  # int32
     max_cat_to_onehot: jax.Array  # int32
     min_data_per_group: jax.Array
+    # CEGB (cost_effective_gradient_boosting.hpp:79 DeltaGain)
+    cegb_tradeoff: jax.Array
+    cegb_penalty_split: jax.Array
+    # per-node feature sampling rate (ColSampler feature_fraction_bynode)
+    feature_fraction_bynode: jax.Array
 
 
 class SplitRecord(NamedTuple):
@@ -257,11 +262,14 @@ def best_split(
     parent_output: jax.Array = 0.0,  # the leaf's current output (smoothing)
     cmin: jax.Array = -BIG,  # monotone-constraint interval of the leaf
     cmax: jax.Array = BIG,
+    penalty: Optional[jax.Array] = None,  # (F,) — CEGB DeltaGain subtraction
+    rand_bin: Optional[jax.Array] = None,  # (F,) — extra_trees: the single
+    # numerical threshold candidate per feature (random per node)
 ) -> SplitRecord:
     """Find the best split of a leaf with given histogram and totals."""
     return _best_split_impl(
         hist, sum_g, sum_h, sum_c, num_bins, nan_bin, mono, is_cat, params,
-        feat_mask, cat_subset, parent_output, cmin, cmax,
+        feat_mask, cat_subset, parent_output, cmin, cmax, penalty, rand_bin,
     )[0]
 
 
@@ -284,6 +292,7 @@ def feature_best_gains(
 def _best_split_impl(
     hist, sum_g, sum_h, sum_c, num_bins, nan_bin, mono, is_cat, params,
     feat_mask, cat_subset, parent_output, cmin, cmax,
+    penalty=None, rand_bin=None,
 ):
     _, F, B = hist.shape
     g = hist[0]
@@ -379,7 +388,16 @@ def _best_split_impl(
     ok = jnp.stack(oks, axis=-1)
     if feat_mask is not None:
         ok &= feat_mask[:, None, None]
+    if rand_bin is not None:
+        # extra_trees: one random numerical threshold per feature per
+        # node (col_sampler / feature_histogram extra-trees scan); the
+        # categorical directions keep their full search
+        ok &= is_cat[:, None, None] | (bin_idx == rand_bin[:, None])[:, :, None]
     gains = jnp.where(ok, gains, NEG_INF)
+    if penalty is not None:
+        # CEGB DeltaGain (cost_effective_gradient_boosting.hpp:79):
+        # per-feature acquisition cost subtracted from every candidate
+        gains = gains - penalty[:, None, None]
 
     flat = gains.reshape(-1)
     idx = jnp.argmax(flat)

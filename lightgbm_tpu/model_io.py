@@ -79,6 +79,25 @@ def tree_to_string(t: Tree) -> str:
         buf.write("cat_boundaries=" + _fmt_d(t.cat_boundaries) + "\n")
         buf.write("cat_threshold=" + _fmt_d(t.cat_threshold) + "\n")
     buf.write(f"is_linear={1 if t.is_linear else 0}\n")
+    if t.is_linear:
+        # linear-leaf blocks (tree.cpp:381-405 Tree::ToString is_linear)
+        buf.write("leaf_const=" + _fmt_hp(t.leaf_const) + "\n")
+        nfeat = [len(f) for f in t.leaf_features]
+        buf.write("num_features=" + " ".join(str(x) for x in nfeat) + "\n")
+        buf.write(
+            "leaf_features="
+            + " ".join(
+                " ".join(str(f) for f in feats) for feats in t.leaf_features if feats
+            )
+            + "\n"
+        )
+        buf.write(
+            "leaf_coeff="
+            + " ".join(
+                " ".join(repr(float(c)) for c in cs) for cs in t.leaf_coeff if cs
+            )
+            + "\n"
+        )
     buf.write(f"shrinkage={t.shrinkage:g}\n")
     buf.write("\n")
     return buf.getvalue()
@@ -294,6 +313,22 @@ def parse_tree_block(lines: Dict[str, str]) -> Tree:
         t.cat_boundaries = _parse_array(lines["cat_boundaries"], np.int64)
         t.cat_threshold = _parse_array(lines["cat_threshold"], np.uint32).astype(np.uint32)
     t.is_linear = lines.get("is_linear", "0").strip() == "1"
+    if t.is_linear:
+        t.leaf_const = _parse_array(lines.get("leaf_const", ""), np.float64)
+        if len(t.leaf_const) < n:
+            t.leaf_const = np.concatenate(
+                [t.leaf_const, np.zeros(n - len(t.leaf_const))]
+            )
+        nfeat = _parse_array(lines.get("num_features", ""), np.int64)
+        flat_f = _parse_array(lines.get("leaf_features", ""), np.int64)
+        flat_c = _parse_array(lines.get("leaf_coeff", ""), np.float64)
+        t.leaf_features, t.leaf_coeff = [], []
+        pos = 0
+        for li in range(n):
+            k = int(nfeat[li]) if li < len(nfeat) else 0
+            t.leaf_features.append([int(x) for x in flat_f[pos : pos + k]])
+            t.leaf_coeff.append([float(x) for x in flat_c[pos : pos + k]])
+            pos += k
     t.shrinkage = float(lines.get("shrinkage", "1"))
     return t
 

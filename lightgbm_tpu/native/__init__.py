@@ -1,0 +1,125 @@
+"""Native (C++) runtime components, loaded through ctypes.
+
+The reference's data loader is C++ (src/io/parser.cpp, text_reader.h);
+this package holds the TPU build's native equivalents. Libraries are
+compiled ON DEMAND with the system toolchain (g++ -O3 -shared) and
+cached next to the source; everything degrades gracefully to the pure
+NumPy fallbacks when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fastparse.cpp")
+_LIB = os.path.join(_DIR, "_fastparse.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", _LIB,
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+        if r.returncode != 0:
+            from .. import log
+
+            log.warning(
+                f"native fastparse build failed (falling back to numpy "
+                f"parsers): {r.stderr.strip()[-300:]}"
+            )
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The fastparse library, building it on first use; None if
+    unavailable (no g++ / build failure)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        fresh = (
+            os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        )
+        if not fresh and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.fp_parse_delim.restype = ctypes.c_int
+        lib.fp_parse_delim.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.fp_parse_libsvm.restype = ctypes.c_int
+        lib.fp_parse_libsvm.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.fp_free.restype = None
+        lib.fp_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+        _lib = lib
+        return _lib
+
+
+def _take(lib, ptr, shape) -> np.ndarray:
+    arr = np.ctypeslib.as_array(ptr, shape=shape).copy()
+    lib.fp_free(ptr)
+    return arr
+
+
+def parse_delim(path: str, delim: str, skip_rows: int) -> Optional[np.ndarray]:
+    """(rows, cols) float64 matrix, or None when native is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.fp_parse_delim(
+        path.encode(), delim.encode(), skip_rows,
+        ctypes.byref(out), ctypes.byref(rows), ctypes.byref(cols),
+    )
+    if rc != 0:
+        return None
+    return _take(lib, out, (rows.value, cols.value))
+
+
+def parse_libsvm(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(labels (N,), dense features (N, F)) or None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_double)()
+    lab = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.fp_parse_libsvm(
+        path.encode(), ctypes.byref(out), ctypes.byref(lab),
+        ctypes.byref(rows), ctypes.byref(cols),
+    )
+    if rc != 0:
+        return None
+    feats = _take(lib, out, (rows.value, cols.value))
+    labels = _take(lib, lab, (rows.value,))
+    return labels, feats

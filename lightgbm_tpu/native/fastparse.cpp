@@ -1,0 +1,267 @@
+// Native text-ingest kernel for the data loader.
+//
+// The reference's DatasetLoader reads training text through C++ parsers
+// (src/io/parser.cpp CSV/TSV/LibSVM + PipelineReader); this is the
+// TPU build's equivalent native front-end: a small C++17 shared
+// library, loaded via ctypes (lightgbm_tpu/native/__init__.py), that
+// turns delimited text / LibSVM into dense row-major double matrices.
+// Parsing is parallelized over line ranges with std::thread (the
+// reference parallelizes by OpenMP rows, dataset_loader.cpp).
+//
+// Plain C ABI on purpose: no Python.h, no pybind11 — the caller owns
+// NumPy allocation and copies out of the returned malloc'd buffer.
+
+#include <cctype>
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  ~FileBuf() { std::free(data); }
+};
+
+bool read_file(const char* path, FileBuf* out) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  if (sz < 0) {
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  out->data = static_cast<char*>(std::malloc(static_cast<size_t>(sz) + 1));
+  if (!out->data) {
+    std::fclose(f);
+    return false;
+  }
+  size_t rd = std::fread(out->data, 1, static_cast<size_t>(sz), f);
+  std::fclose(f);
+  out->size = rd;
+  out->data[rd] = '\0';
+  return true;
+}
+
+// line start offsets (excluding trailing empty line)
+std::vector<size_t> line_starts(const char* s, size_t n) {
+  std::vector<size_t> starts;
+  size_t i = 0;
+  while (i < n) {
+    starts.push_back(i);
+    const char* nl = static_cast<const char*>(std::memchr(s + i, '\n', n - i));
+    if (!nl) break;
+    i = static_cast<size_t>(nl - s) + 1;
+  }
+  return starts;
+}
+
+size_t line_end(const char* s, size_t n, size_t start) {
+  const char* nl =
+      static_cast<const char*>(std::memchr(s + start, '\n', n - start));
+  size_t e = nl ? static_cast<size_t>(nl - s) : n;
+  while (e > start && (s[e - 1] == '\r')) --e;
+  return e;
+}
+
+double parse_field(const char* b, const char* e) {
+  while (b < e && std::isspace(static_cast<unsigned char>(*b))) ++b;
+  while (e > b && std::isspace(static_cast<unsigned char>(e[-1]))) --e;
+  if (b == e) return std::nan("");
+  if ((e - b) <= 4) {
+    // na / nan / null / none / ? (Common::AtofPrecise missing tokens)
+    char buf[5];
+    int k = 0;
+    for (const char* p = b; p < e; ++p)
+      buf[k++] = static_cast<char>(std::tolower(static_cast<unsigned char>(*p)));
+    buf[k] = '\0';
+    if (!std::strcmp(buf, "na") || !std::strcmp(buf, "nan") ||
+        !std::strcmp(buf, "null") || !std::strcmp(buf, "none") ||
+        !std::strcmp(buf, "?"))
+      return std::nan("");
+  }
+  char* endp = nullptr;
+  std::string tmp(b, e);  // strtod needs NUL termination
+  double v = std::strtod(tmp.c_str(), &endp);
+  if (endp == tmp.c_str()) return std::nan("");
+  return v;
+}
+
+int n_threads_for(size_t rows) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  size_t by_rows = rows / 4096 + 1;
+  return static_cast<int>(by_rows < hw ? by_rows : hw);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a delimited numeric file into a dense row-major matrix.
+// Returns 0 on success; caller frees *out with fp_free.
+int fp_parse_delim(const char* path, char delim, int skip_rows,
+                   double** out, int64_t* out_rows, int64_t* out_cols) {
+  FileBuf fb;
+  if (!read_file(path, &fb)) return 1;
+  std::vector<size_t> starts = line_starts(fb.data, fb.size);
+  // drop skipped header rows and blank trailing lines
+  size_t first = static_cast<size_t>(skip_rows) < starts.size()
+                     ? static_cast<size_t>(skip_rows)
+                     : starts.size();
+  // skip BLANK lines entirely (np.loadtxt semantics — the numpy
+  // fallback must see the same row set)
+  std::vector<size_t> rows_;
+  for (size_t i = first; i < starts.size(); ++i) {
+    if (line_end(fb.data, fb.size, starts[i]) > starts[i])
+      rows_.push_back(starts[i]);
+  }
+  int64_t n_rows = static_cast<int64_t>(rows_.size());
+  if (n_rows == 0) return 2;
+
+  // column count from the first data row
+  size_t e0 = line_end(fb.data, fb.size, rows_[0]);
+  int64_t n_cols = 1;
+  for (size_t i = rows_[0]; i < e0; ++i)
+    if (fb.data[i] == delim) ++n_cols;
+
+  double* mat = static_cast<double*>(
+      std::malloc(sizeof(double) * static_cast<size_t>(n_rows * n_cols)));
+  if (!mat) return 3;
+
+  int nt = n_threads_for(static_cast<size_t>(n_rows));
+  std::vector<std::thread> threads;
+  std::vector<int> errs(static_cast<size_t>(nt), 0);
+  auto work = [&](int tid) {
+    int64_t lo = n_rows * tid / nt, hi = n_rows * (tid + 1) / nt;
+    for (int64_t r = lo; r < hi; ++r) {
+      size_t b = rows_[static_cast<size_t>(r)];
+      size_t e = line_end(fb.data, fb.size, b);
+      int64_t c = 0;
+      size_t fs = b;
+      for (size_t i = b; i <= e && c < n_cols; ++i) {
+        if (i == e || fb.data[i] == delim) {
+          mat[r * n_cols + c] = parse_field(fb.data + fs, fb.data + i);
+          ++c;
+          fs = i + 1;
+        }
+      }
+      for (; c < n_cols; ++c) mat[r * n_cols + c] = std::nan("");
+    }
+  };
+  for (int t = 0; t < nt; ++t) threads.emplace_back(work, t);
+  for (auto& th : threads) th.join();
+
+  *out = mat;
+  *out_rows = n_rows;
+  *out_cols = n_cols;
+  return 0;
+}
+
+// Parse LibSVM ("label idx:val idx:val ...", 0- or 1-based indices kept
+// as-is) into a dense (rows, max_idx+1) matrix of zeros + a label vec.
+int fp_parse_libsvm(const char* path, double** out, double** out_label,
+                    int64_t* out_rows, int64_t* out_cols) {
+  FileBuf fb;
+  if (!read_file(path, &fb)) return 1;
+  std::vector<size_t> starts = line_starts(fb.data, fb.size);
+  while (!starts.empty() &&
+         line_end(fb.data, fb.size, starts.back()) == starts.back())
+    starts.pop_back();
+  int64_t n_rows = static_cast<int64_t>(starts.size());
+  if (n_rows == 0) return 2;
+
+  // pass 1 (parallel): max feature index per thread
+  int nt = n_threads_for(static_cast<size_t>(n_rows));
+  std::vector<int64_t> maxidx(static_cast<size_t>(nt), -1);
+  {
+    std::vector<std::thread> threads;
+    auto scan = [&](int tid) {
+      int64_t lo = n_rows * tid / nt, hi = n_rows * (tid + 1) / nt;
+      int64_t mx = -1;
+      for (int64_t r = lo; r < hi; ++r) {
+        size_t b = starts[static_cast<size_t>(r)];
+        size_t e = line_end(fb.data, fb.size, b);
+        for (size_t i = b; i < e; ++i) {
+          if (fb.data[i] == ':') {
+            size_t j = i;
+            while (j > b && std::isdigit(static_cast<unsigned char>(
+                                fb.data[j - 1])))
+              --j;
+            int64_t idx = std::strtoll(std::string(fb.data + j, fb.data + i).c_str(),
+                                       nullptr, 10);
+            if (idx > mx) mx = idx;
+          }
+        }
+      }
+      maxidx[static_cast<size_t>(tid)] = mx;
+    };
+    for (int t = 0; t < nt; ++t) threads.emplace_back(scan, t);
+    for (auto& th : threads) th.join();
+  }
+  int64_t n_cols = 0;
+  for (int64_t m : maxidx)
+    if (m + 1 > n_cols) n_cols = m + 1;
+  if (n_cols == 0) return 2;
+
+  double* mat = static_cast<double*>(
+      std::calloc(static_cast<size_t>(n_rows * n_cols), sizeof(double)));
+  double* lab = static_cast<double*>(
+      std::malloc(sizeof(double) * static_cast<size_t>(n_rows)));
+  if (!mat || !lab) {
+    std::free(mat);
+    std::free(lab);
+    return 3;
+  }
+
+  std::vector<std::thread> threads;
+  auto work = [&](int tid) {
+    int64_t lo = n_rows * tid / nt, hi = n_rows * (tid + 1) / nt;
+    for (int64_t r = lo; r < hi; ++r) {
+      size_t b = starts[static_cast<size_t>(r)];
+      size_t e = line_end(fb.data, fb.size, b);
+      size_t i = b;
+      while (i < e && !std::isspace(static_cast<unsigned char>(fb.data[i])))
+        ++i;
+      lab[r] = parse_field(fb.data + b, fb.data + i);
+      while (i < e) {
+        while (i < e && std::isspace(static_cast<unsigned char>(fb.data[i])))
+          ++i;
+        size_t fs = i;
+        while (i < e && fb.data[i] != ':' &&
+               !std::isspace(static_cast<unsigned char>(fb.data[i])))
+          ++i;
+        if (i >= e || fb.data[i] != ':') continue;
+        int64_t idx = std::strtoll(
+            std::string(fb.data + fs, fb.data + i).c_str(), nullptr, 10);
+        ++i;
+        size_t vs = i;
+        while (i < e && !std::isspace(static_cast<unsigned char>(fb.data[i])))
+          ++i;
+        if (idx >= 0 && idx < n_cols)
+          mat[r * n_cols + idx] = parse_field(fb.data + vs, fb.data + i);
+      }
+    }
+  };
+  for (int t = 0; t < nt; ++t) threads.emplace_back(work, t);
+  for (auto& th : threads) th.join();
+
+  *out = mat;
+  *out_label = lab;
+  *out_rows = n_rows;
+  *out_cols = n_cols;
+  return 0;
+}
+
+void fp_free(double* p) { std::free(p); }
+
+}  // extern "C"

@@ -476,7 +476,7 @@ class LambdaRank(ObjectiveFunction):
 
         weight_dev = self.weight
 
-        def _grads(score):
+        def _raw(score):
             g, h = lambdarank_gradients(
                 layout, score, label_dev, gain_dev, imd_dev, sig, trunc, norm
             )
@@ -485,6 +485,10 @@ class LambdaRank(ObjectiveFunction):
             if weight_dev is not None:
                 g = g * weight_dev
                 h = h * weight_dev
+            return g, h
+
+        def _grads(score):
+            g, h = _raw(score)
             # tiny hessian floor keeps leaf outputs finite on degenerate
             # queries (all-equal labels contribute zero hessian)
             return g, jnp.maximum(h, 2e-7)
@@ -494,8 +498,55 @@ class LambdaRank(ObjectiveFunction):
         # instead of re-uploading it per call
         self._grads = jax.jit(_grads)
 
+        # ---- position-bias debiasing (rank_objective.hpp:55-98,302):
+        # scores are adjusted by a per-position bias factor before the
+        # lambda computation, and the factors take a Newton-Raphson step
+        # from the accumulated lambdas/hessians each iteration. The
+        # factors are cross-iteration HOST state, so this objective
+        # leaves the fused loop when positions are present.
+        self._pos_biases = None
+        pos = self._meta.position
+        if pos is not None:
+            pos = np.asarray(pos, np.int64)
+            P = int(pos.max()) + 1
+            posp = np.zeros(npad, np.int64)
+            posp[: len(pos)] = pos
+            positions = jnp.asarray(posp.astype(np.int32))
+            valid_rows = jnp.asarray(
+                (np.arange(npad) < len(pos)).astype(np.float32)
+            )
+            reg = jnp.float32(
+                self.config.lambdarank_position_bias_regularization
+            )
+            lr = jnp.float32(self.config.learning_rate)
+            self._pos_biases = jnp.zeros(P, jnp.float32)
+            self.has_host_state = True
+
+            def _grads_pos(score, biases):
+                adj = score + biases[positions]
+                g, h = _raw(adj)
+                # UpdatePositionBiasFactors: Newton step on the utility
+                # derivatives w.r.t. each position's bias factor
+                d1 = jnp.zeros(P).at[positions].add(-g * valid_rows)
+                d2 = jnp.zeros(P).at[positions].add(-h * valid_rows)
+                cnt = jnp.zeros(P).at[positions].add(valid_rows)
+                d1 = d1 - biases * reg * cnt
+                d2 = d2 - reg * cnt
+                new_biases = biases + lr * d1 / (jnp.abs(d2) + 0.001)
+                return g, jnp.maximum(h, 2e-7), new_biases
+
+            self._grads_pos = jax.jit(_grads_pos)
+
     def get_gradients(self, score):
+        if self._pos_biases is not None:
+            g, h, self._pos_biases = self._grads_pos(score, self._pos_biases)
+            return g, h
         return self._grads(score)
+
+    @property
+    def position_biases(self):
+        """Learned per-position bias factors (None without positions)."""
+        return self._pos_biases
 
     def convert_output(self, score):
         return score

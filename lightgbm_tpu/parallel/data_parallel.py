@@ -54,17 +54,20 @@ class DataParallelGrower:
         bins_spec = P(None, axis_name)  # bins are (F, N): rows on axis 1
         rep = P()
 
-        def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask, params, valid, bundle):
+        def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+               feat_mask, params, valid, bundle, rng_key, group_mat, cegb):
             tree, row_leaf = grow_tree(
                 bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
                 feat_mask, params, self.spec, valid=valid, bundle=bundle,
+                rng_key=rng_key, group_mat=group_mat, cegb=cegb,
             )
             # tree state is identical on all shards (computed from psum'd
             # histograms); mark it replicated for the out_spec
             tree = jax.tree.map(lambda a: jax.lax.pmean(a, axis_name) if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
             return tree, row_leaf
 
-        in_specs = (bins_spec, rep, rep, rep, rep, row, row, row, rep, rep, row, rep)
+        in_specs = (bins_spec, rep, rep, rep, rep, row, row, row, rep, rep,
+                    row, rep, rep, rep, rep)
         out_specs = (jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)), row)
         self._fn = jax.jit(
             jax.shard_map(
@@ -78,10 +81,11 @@ class DataParallelGrower:
 
     def __call__(self, bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
                  feat_mask, params: SplitParams, valid, bundle=None,
+                 rng_key=None, group_mat=None, cegb=None,
                  ) -> Tuple[TreeArrays, jax.Array]:
         return self._fn(
             bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask,
-            params, valid, bundle,
+            params, valid, bundle, rng_key, group_mat, cegb,
         )
 
     def shard_inputs(self, dev: dict) -> dict:

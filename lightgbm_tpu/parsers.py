@@ -67,9 +67,15 @@ def _parse_delim(path: Path, delim: str, header: bool) -> Tuple[np.ndarray, List
         first = _read_lines(path, 1)[0]
         names = [c.strip() for c in first.split(delim)]
         skip = 1
-    data = np.loadtxt(
-        path, delimiter=delim, skiprows=skip, dtype=np.float64, ndmin=2,
-    )
+    # native C++ fast path (native/fastparse.cpp, the reference's
+    # src/io/parser.cpp CSVParser equivalent); numpy fallback otherwise
+    from . import native
+
+    data = native.parse_delim(str(path), delim, skip)
+    if data is None:
+        data = np.loadtxt(
+            path, delimiter=delim, skiprows=skip, dtype=np.float64, ndmin=2,
+        )
     return data, names
 
 
@@ -77,6 +83,11 @@ def _parse_libsvm(path: Path) -> Tuple[np.ndarray, np.ndarray]:
     """LibSVM 'label idx:val ...' -> (label, dense matrix); 0-based or
     1-based indices both appear in the wild — indices are used as-is
     (reference LibSVMParser keeps raw indices)."""
+    from . import native
+
+    res = native.parse_libsvm(str(path))
+    if res is not None:
+        return res
     labels: List[float] = []
     rows: List[Dict[int, float]] = []
     max_idx = -1

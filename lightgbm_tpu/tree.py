@@ -60,6 +60,12 @@ class Tree:
     cat_boundaries: np.ndarray = field(default_factory=lambda: np.zeros(1, np.int64))
     cat_threshold: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint32))
     is_linear: bool = False
+    # linear leaves (tree.h leaf_const_/leaf_coeff_/leaf_features_):
+    # output = leaf_const + sum(coeff * raw feature), falling back to
+    # leaf_value when any leaf feature is NaN (tree.cpp:137-153)
+    leaf_const: np.ndarray = field(default_factory=lambda: np.zeros(0, np.float64))
+    leaf_features: List[List[int]] = field(default_factory=list)
+    leaf_coeff: List[List[float]] = field(default_factory=list)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -229,7 +235,88 @@ class Tree:
         return ~cur  # leaf index
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        return self.leaf_value[self.predict_leaf(X)]
+        leaf = self.predict_leaf(X)
+        if not self.is_linear:
+            return self.leaf_value[leaf]
+        return self.linear_leaf_outputs(X, leaf)
+
+    def linear_leaf_outputs(self, X: np.ndarray, leaf: np.ndarray) -> np.ndarray:
+        """Linear-leaf outputs per row (tree.cpp:137-153 PredictionFun
+        with is_linear): const + coeffs . raw features, NaN -> leaf_value."""
+        out = self.leaf_value[leaf].astype(np.float64).copy()
+        for l in range(self.num_leaves):
+            m = leaf == l
+            if not np.any(m):
+                continue
+            feats = self.leaf_features[l] if l < len(self.leaf_features) else []
+            const = self.leaf_const[l] if l < len(self.leaf_const) else 0.0
+            if not feats:
+                out[m] = const
+                continue
+            Xl = np.asarray(X, np.float64)[np.ix_(m, feats)]
+            v = const + Xl @ np.asarray(self.leaf_coeff[l], np.float64)
+            nanrow = np.isnan(Xl).any(axis=1)
+            out[m] = np.where(nanrow, self.leaf_value[l], v)
+        return out
+
+    def fit_linear_leaves(self, row_leaf: np.ndarray, grad: np.ndarray,
+                          hess: np.ndarray, raw: np.ndarray,
+                          cat_features: set, linear_lambda: float,
+                          shrinkage: float,
+                          row_mask: "np.ndarray | None" = None) -> None:
+        """Fit one ridge model per leaf on the leaf's PATH features
+        (linear_tree_learner.cpp:255-358 CalculateLinear): accumulate
+        X^T H X / X^T g over non-NaN leaf rows, solve
+        coeffs = -(X^T H X + lambda I)^-1 X^T g, scale by shrinkage.
+        Degenerate leaves (fewer usable rows than coefficients) keep the
+        plain leaf_value as a constant."""
+        L = self.num_leaves
+        paths: List[List[int]] = [[] for _ in range(L)]
+
+        def walk(node, feats):
+            if node < 0:
+                paths[~node] = feats
+                return
+            f = int(self.split_feature[node])
+            nf = feats if (f in cat_features or f in feats) else feats + [f]
+            walk(int(self.left_child[node]), nf)
+            walk(int(self.right_child[node]), nf)
+
+        if L > 1:
+            walk(0, [])
+        self.is_linear = True
+        self.leaf_const = self.leaf_value.astype(np.float64).copy()
+        self.leaf_features = [list(p) for p in paths]
+        self.leaf_coeff = [[0.0] * len(p) for p in paths]
+        raw = np.asarray(raw, np.float64)
+        for leaf in range(L):
+            feats = paths[leaf]
+            k = len(feats)
+            sel = row_leaf == leaf
+            if row_mask is not None:  # in-bag rows only (bagging / GOSS)
+                sel = sel & row_mask
+            if k == 0 or not np.any(sel):
+                continue
+            Xl = raw[np.ix_(sel, feats)]
+            ok = ~np.isnan(Xl).any(axis=1)
+            if int(ok.sum()) < k + 1:
+                continue
+            Xa = np.concatenate(
+                [Xl[ok], np.ones((int(ok.sum()), 1))], axis=1
+            )
+            g = np.asarray(grad, np.float64)[sel][ok]
+            h = np.asarray(hess, np.float64)[sel][ok]
+            A = (Xa.T * h) @ Xa
+            A[np.arange(k), np.arange(k)] += linear_lambda
+            b = Xa.T @ g
+            try:
+                coef = -np.linalg.solve(A, b)
+            except np.linalg.LinAlgError:
+                continue
+            if not np.isfinite(coef).all():
+                continue
+            self.leaf_coeff[leaf] = [float(c) * shrinkage for c in coef[:k]]
+            self.leaf_const[leaf] = float(coef[k]) * shrinkage
 
     def feature_importance_split(self, num_features: int) -> np.ndarray:
         imp = np.zeros(num_features)

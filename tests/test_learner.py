@@ -18,6 +18,8 @@ def _params(**kw):
         min_sum_hessian_in_leaf=0.0, min_gain_to_split=0.0,
         max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
         cat_l2=10.0, min_data_per_group=100.0,
+        cegb_tradeoff=1.0, cegb_penalty_split=0.0,
+        feature_fraction_bynode=1.0,
     )
     ints = dict(max_cat_threshold=32, max_cat_to_onehot=4)
     for k in list(kw):

@@ -1,0 +1,81 @@
+"""Linear trees (linear_tree): per-leaf ridge models on path features
+(reference linear_tree_learner.cpp, tree.cpp is_linear blocks)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _linear_problem(n=3000, seed=11):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 4)
+    # piecewise-linear target: trees split on X0, linear leaves capture
+    # the in-segment slope of X1
+    y = np.where(X[:, 0] > 0, 2.0 + 3.0 * X[:, 1], -1.0 - 2.0 * X[:, 1])
+    y = y + 0.05 * rs.randn(n)
+    return X, y
+
+
+def test_linear_tree_beats_piecewise_constant():
+    X, y = _linear_problem()
+    params = dict(objective="regression", num_leaves=4, min_data_in_leaf=20,
+                  learning_rate=0.5, verbosity=-1)
+    mses = {}
+    for lin in (False, True):
+        ds = lgb.Dataset(X, label=y, params={"linear_tree": lin},
+                         free_raw_data=False)
+        bst = lgb.train({**params, "linear_tree": lin}, ds,
+                        num_boost_round=10)
+        mses[lin] = float(np.mean((bst.predict(X) - y) ** 2))
+    # a handful of linear leaves capture the slopes that constant leaves
+    # can only staircase-approximate
+    assert mses[True] < 0.25 * mses[False], mses
+
+
+def test_linear_tree_model_roundtrip(tmp_path):
+    X, y = _linear_problem(seed=12)
+    ds = lgb.Dataset(X, label=y, params={"linear_tree": True},
+                     free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 5, "linear_tree": True,
+         "min_data_in_leaf": 20, "verbosity": -1},
+        ds, num_boost_round=4,
+    )
+    assert any(t.is_linear for t in bst._gbdt.models)
+    p = str(tmp_path / "linear.txt")
+    bst.save_model(p)
+    assert "leaf_coeff=" in open(p).read()
+    b2 = lgb.Booster(model_file=p)
+    np.testing.assert_allclose(
+        bst.predict(X), b2.predict(X), rtol=1e-6, atol=1e-8
+    )
+
+
+def test_linear_tree_nan_falls_back_to_leaf_value():
+    X, y = _linear_problem(seed=13)
+    ds = lgb.Dataset(X, label=y, params={"linear_tree": True},
+                     free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 4, "linear_tree": True,
+         "min_data_in_leaf": 20, "verbosity": -1},
+        ds, num_boost_round=3,
+    )
+    Xq = X[:50].copy()
+    Xq[:, 1] = np.nan  # leaf feature NaN -> plain leaf_value path
+    pred = bst.predict(Xq)
+    assert np.isfinite(pred).all()
+    # must differ from the linear outputs on the clean rows
+    assert not np.allclose(pred, bst.predict(X[:50]))
+
+
+def test_linear_tree_shap_raises():
+    X, y = _linear_problem(seed=14)
+    ds = lgb.Dataset(X, label=y, params={"linear_tree": True},
+                     free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 4, "linear_tree": True,
+         "verbosity": -1}, ds, num_boost_round=2,
+    )
+    with pytest.raises(Exception):
+        bst.predict(X[:10], pred_contrib=True)

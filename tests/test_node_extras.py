@@ -1,0 +1,112 @@
+"""Per-node split extras: extra_trees, feature_fraction_bynode,
+interaction_constraints, CEGB penalties (reference
+col_sampler.hpp / cost_effective_gradient_boosting.hpp)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _problem(n=3000, f=6, seed=4):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    w = rs.randn(f)
+    y = ((X @ w + 0.3 * rs.randn(n)) > 0).astype(np.float64)
+    return X, y
+
+
+BASE = dict(objective="binary", num_leaves=15, min_data_in_leaf=5,
+            verbosity=-1)
+
+
+def _train(params, X, y, rounds=5):
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    return lgb.train(dict(params), ds, num_boost_round=rounds)
+
+
+def _tree_paths(tree):
+    """All root->leaf feature paths of a host Tree."""
+    paths = []
+
+    def walk(node, feats):
+        if node < 0:
+            paths.append(feats)
+            return
+        f = int(tree.split_feature[node])
+        walk(int(tree.left_child[node]), feats | {f})
+        walk(int(tree.right_child[node]), feats | {f})
+
+    if tree.num_leaves > 1:
+        walk(0, set())
+    return paths
+
+
+def test_extra_trees_runs_and_differs():
+    X, y = _problem()
+    b0 = _train(BASE, X, y)
+    b1 = _train({**BASE, "extra_trees": True}, X, y)
+    b2 = _train({**BASE, "extra_trees": True}, X, y)
+    # deterministic given the seed, different from the exhaustive scan
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X))
+    assert not np.allclose(b0.predict(X), b1.predict(X))
+    from sklearn.metrics import roc_auc_score
+
+    assert roc_auc_score(y, b1.predict(X)) > 0.8  # still learns
+
+
+def test_feature_fraction_bynode():
+    X, y = _problem()
+    b = _train({**BASE, "feature_fraction_bynode": 0.5}, X, y)
+    assert b.num_trees() == 5
+    # per-node sampling: across all trees more than bynode*F distinct
+    # features appear (per-TREE sampling with fraction 0.5 could too,
+    # but per-node must; smoke-level assertion)
+    feats = set()
+    for t in b._gbdt.models:
+        for p in _tree_paths(t):
+            feats |= p
+    assert len(feats) >= 4
+
+
+def test_interaction_constraints_respected():
+    X, y = _problem(f=6)
+    b = _train(
+        {**BASE, "interaction_constraints": "[0,1,2],[3,4,5]"}, X, y,
+        rounds=8,
+    )
+    groups = [set([0, 1, 2]), set([3, 4, 5])]
+    for t in b._gbdt.models:
+        for path in _tree_paths(t):
+            assert any(path <= g for g in groups), (
+                f"path {path} spans constraint groups"
+            )
+
+
+def test_cegb_split_penalty_shrinks_trees():
+    X, y = _problem()
+    b0 = _train(BASE, X, y)
+    # a huge per-data split penalty makes every split unprofitable
+    b1 = _train({**BASE, "cegb_tradeoff": 1.0, "cegb_penalty_split": 1e6},
+                X, y)
+    n0 = sum(t.num_leaves for t in b0._gbdt.models)
+    n1 = sum(t.num_leaves for t in b1._gbdt.models)
+    assert n1 < n0
+    assert all(t.num_leaves == 1 for t in b1._gbdt.models)
+
+
+def test_cegb_coupled_penalty_avoids_expensive_feature():
+    rs = np.random.RandomState(8)
+    X = rs.randn(3000, 3)
+    # feature 0 slightly better than feature 1, feature 2 noise
+    y = ((1.0 * X[:, 0] + 0.9 * X[:, 1] + 0.2 * rs.randn(3000)) > 0).astype(
+        np.float64
+    )
+    pen = [1e6, 0.0, 0.0]
+    b = _train(
+        {**BASE, "cegb_tradeoff": 1.0,
+         "cegb_penalty_feature_coupled": pen}, X, y, rounds=4,
+    )
+    for t in b._gbdt.models:
+        for p in _tree_paths(t):
+            assert 0 not in p, "penalized feature was used"

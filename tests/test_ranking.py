@@ -245,3 +245,33 @@ def test_xentlambda_weighted_and_unweighted():
     b2 = lgb.train({"objective": "xentlambda", "num_leaves": 15,
                     "verbosity": -1}, ds2, num_boost_round=5)
     assert np.isfinite(b2.predict(X)).all()
+
+
+def test_lambdarank_position_bias():
+    """Position debiasing (rank_objective.hpp:302): with click-style
+    labels biased toward early positions, the learned per-position bias
+    factors must be (roughly) decreasing in position."""
+    rs = np.random.RandomState(3)
+    n_q, docs = 120, 8
+    n = n_q * docs
+    rel = rs.randint(0, 3, n).astype(np.float64)  # true relevance
+    pos = np.tile(np.arange(docs), n_q)
+    # observed label: relevance observed only when the position is seen
+    seen = rs.rand(n) < (1.0 / (1.0 + 0.7 * pos))
+    label = np.where(seen, rel, 0.0)
+    X = rs.randn(n, 5)
+    X[:, 0] += rel  # informative feature
+    group = np.full(n_q, docs)
+
+    ds = lgb.Dataset(X, label=label, group=group, position=pos,
+                     free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "lambdarank", "num_leaves": 7, "min_data_in_leaf": 3,
+         "lambdarank_position_bias_regularization": 0.5, "verbosity": -1},
+        ds, num_boost_round=10,
+    )
+    biases = np.asarray(bst._gbdt.objective.position_biases)
+    assert biases.shape == (docs,)
+    assert np.any(biases != 0.0)
+    # later positions get lower (more negative) bias factors
+    assert biases[0] > biases[-1]
