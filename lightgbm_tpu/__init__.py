@@ -19,7 +19,7 @@ The public Python API mirrors the reference python-package
 ports with an import change.
 """
 
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, Sequence, set_network
 from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
 from .engine import CVBooster, cv, train
 from .log import register_logger
@@ -31,6 +31,8 @@ __version__ = "0.1.0"
 __all__ = [
     "Booster",
     "Dataset",
+    "Sequence",
+    "set_network",
     "CVBooster",
     "cv",
     "train",

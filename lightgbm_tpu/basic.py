@@ -24,6 +24,64 @@ from .log import LightGBMError
 _ArrayLike = Union[np.ndarray, "list", "tuple"]
 
 
+def set_network(
+    machines: Any,
+    local_listen_port: int = 12400,
+    listen_time_out: int = 120,
+    num_machines: int = 1,
+    *,
+    machine_list_file: str = "",
+    machine_rank: "int | None" = None,
+) -> None:
+    """Join the multi-host training cluster (reference
+    basic.py:3773 set_network -> LGBM_NetworkInit; positional order
+    matches: machines, local_listen_port, listen_time_out,
+    num_machines). On the TPU build this forms the JAX multi-controller
+    cluster (parallel/multihost.py); collectives then ride ICI/DCN
+    through the same grower code as single-host. listen_time_out is
+    accepted for API parity (the cluster handshake timeout is managed
+    by jax.distributed)."""
+    del listen_time_out
+    from .parallel import multihost
+
+    if machines is not None and not isinstance(machines, str):
+        machines = ",".join(str(m) for m in machines)
+    multihost.init_distributed(
+        machines=machines or None,
+        machine_list_file=machine_list_file or None,
+        num_machines=num_machines if num_machines > 1 else None,
+        local_listen_port=local_listen_port,
+        machine_rank=machine_rank,
+    )
+
+
+class Sequence:
+    """Generic random-access data sequence for streaming Dataset
+    construction (reference basic.py:905 Sequence ABC). Subclass with
+    `__len__` and `__getitem__` (int row or slice -> numpy rows) and
+    optionally set `batch_size`; pass one Sequence or a list of them as
+    `Dataset(data=...)` — the binned matrix is built in two streaming
+    passes without ever materializing the full float64 matrix."""
+
+    batch_size: int = 4096
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __getitem__(self, idx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def _is_sequence_input(data: Any) -> bool:
+    if isinstance(data, Sequence):
+        return True
+    return (
+        isinstance(data, list)
+        and len(data) > 0
+        and all(isinstance(s, Sequence) for s in data)
+    )
+
+
 def _to_2d_numpy(data: Any) -> Tuple[np.ndarray, Optional[List[str]]]:
     feature_name = None
     try:  # pandas support without importing pandas eagerly
@@ -36,6 +94,29 @@ def _to_2d_numpy(data: Any) -> Tuple[np.ndarray, Optional[List[str]]]:
             return data.to_numpy(dtype=np.float64).reshape(-1, 1), None
     except ImportError:
         pass
+    # Arrow ingest (reference include/LightGBM/arrow.h + c_api.cpp:1645
+    # LGBM_DatasetCreateFromArrow): accept pyarrow Table / RecordBatch
+    # column-wise; nulls -> NaN
+    tname = type(data).__module__ + "." + type(data).__name__
+    if tname.startswith("pyarrow."):
+        import pyarrow as pa  # already imported: data IS a pyarrow object
+
+        def _col64(col):
+            # cast first so nullable bool/int columns become float64
+            # with nulls -> NaN (a raw to_numpy would yield an object
+            # array of None that np.asarray cannot float)
+            return np.asarray(
+                col.cast(pa.float64()).to_numpy(zero_copy_only=False)
+            )
+
+        if isinstance(data, pa.RecordBatch):
+            data = pa.Table.from_batches([data])
+        if isinstance(data, pa.Table):
+            feature_name = [str(c) for c in data.column_names]
+            cols = [_col64(data.column(i)) for i in range(data.num_columns)]
+            return np.column_stack(cols), feature_name
+        if isinstance(data, (pa.ChunkedArray, pa.Array)):
+            return _col64(data).reshape(-1, 1), None
     if hasattr(data, "toarray"):  # scipy sparse
         return np.asarray(data.toarray(), dtype=np.float64), None
     arr = np.asarray(data)
@@ -54,6 +135,21 @@ def _to_1d(v: Any) -> Optional[np.ndarray]:
             return v.to_numpy().ravel()
     except ImportError:
         pass
+    if (type(v).__module__ + "." + type(v).__name__).startswith("pyarrow."):
+        import pyarrow as pa  # already imported: v IS a pyarrow object
+
+        if isinstance(v, (pa.ChunkedArray, pa.Array)):
+            return np.asarray(
+                v.cast(pa.float64()).to_numpy(zero_copy_only=False)
+            ).ravel()
+        if isinstance(v, pa.Table):
+            if v.num_columns != 1:
+                raise ValueError(
+                    f"expected a 1-column table, got {v.num_columns} columns"
+                )
+            return np.asarray(
+                v.column(0).cast(pa.float64()).to_numpy(zero_copy_only=False)
+            ).ravel()
     return np.asarray(v).ravel()
 
 
@@ -111,6 +207,37 @@ class Dataset:
         if self.data is None:
             log.fatal("Cannot construct Dataset: raw data was freed")
         from .timer import global_timer as _gt
+
+        if _is_sequence_input(self.data):
+            # streaming two-pass path (reference Sequence / push APIs)
+            seqs = self.data if isinstance(self.data, list) else [self.data]
+            cfg = Config(self.params)
+            names = (
+                [str(n) for n in self.feature_name]
+                if isinstance(self.feature_name, list)
+                else None
+            )
+            cat = self._resolve_categorical(names or [])
+            if cfg.linear_tree:
+                log.fatal(
+                    "linear_tree needs raw feature values; Sequence "
+                    "streaming does not retain them"
+                )
+            with _gt.scope("dataset construct (streaming binning)"):
+                self._binned = BinnedDataset.from_sequences(
+                    seqs,
+                    cfg,
+                    label=self.label,
+                    weight=self.weight,
+                    group=self.group,
+                    init_score=self.init_score,
+                    position=self.position,
+                    categorical_feature=cat,
+                    feature_names=names,
+                )
+            if self.free_raw_data:
+                self.data = None
+            return self
         arr, pandas_names = _to_2d_numpy(self.data)
         if isinstance(self.feature_name, list):
             names = [str(n) for n in self.feature_name]
@@ -288,11 +415,28 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._train_data_name = "training"
         self.pandas_categorical = None
-        self._network_initialized = False
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError(f"Training data should be Dataset instance, met {type(train_set).__name__}")
+            # distributed network params join the multi-host cluster
+            # BEFORE any backend touch (reference basic.py:3606: Booster
+            # calls set_network when machines/num_machines are present).
+            # Aliases resolve through the config table (num_machine,
+            # machine_list/mlist, local_port, workers, ...).
+            from .config import resolve_alias as _ra
+
+            net = {}
+            for k, v in self.params.items():
+                net.setdefault(_ra(k), v)
+            nm = int(net.get("num_machines", 1))
+            if nm > 1:
+                set_network(
+                    machines=net.get("machines", ""),
+                    local_listen_port=int(net.get("local_listen_port", 12400)),
+                    num_machines=nm,
+                    machine_list_file=net.get("machine_list_filename", ""),
+                )
             # params relevant to dataset CONSTRUCTION merge into the
             # dataset (binding at first construct); the booster's config
             # takes only dataset-relevant keys from the dataset so one
@@ -597,5 +741,4 @@ class Booster:
         return self
 
     def free_network(self) -> "Booster":
-        self._network_initialized = False
         return self

@@ -244,6 +244,101 @@ class BinnedDataset:
             bundle_expand=bundle_expand,
         )
 
+    @staticmethod
+    def from_sequences(
+        seqs: Sequence[Any],
+        config: Config,
+        label: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        init_score: Optional[np.ndarray] = None,
+        position: Optional[np.ndarray] = None,
+        categorical_feature: Optional[Sequence[int]] = None,
+        feature_names: Optional[Sequence[str]] = None,
+    ) -> "BinnedDataset":
+        """Two-pass streaming construction from random-access Sequences
+        (reference python Sequence ABC basic.py:905 + streaming push
+        APIs dataset.h:518-627): pass 1 samples rows across all
+        sequences and builds the bin mappers; pass 2 streams
+        batch-sized chunks straight into the int bin matrix — the full
+        float64 matrix is never materialized (4-8x peak-memory saving,
+        the reason the reference's two_round/push path exists).
+        """
+        lens = [len(s) for s in seqs]
+        total = int(np.sum(lens))
+        if total == 0:
+            log.fatal("cannot construct Dataset from empty sequences")
+        rng = np.random.RandomState(config.data_random_seed)
+        n_sample = min(total, config.bin_construct_sample_cnt)
+        idx = np.sort(rng.choice(total, n_sample, replace=False))
+        bounds = np.concatenate([[0], np.cumsum(lens)])
+
+        def _rows(global_rows: np.ndarray) -> np.ndarray:
+            out = []
+            for g in global_rows:
+                s = int(np.searchsorted(bounds, g, side="right")) - 1
+                row = np.asarray(seqs[s][int(g - bounds[s])], np.float64)
+                out.append(row.reshape(-1))
+            return np.asarray(out)
+
+        sample = _rows(idx)
+        # mappers/EFB layout from the sample; then stream-bin all rows
+        proto = BinnedDataset.from_numpy(
+            sample, config,
+            categorical_feature=categorical_feature,
+            feature_names=feature_names,
+        )
+        G = proto.bins.shape[0]
+        dtype = proto.bins.dtype
+        bins = np.empty((G, total), dtype=dtype)
+        used = proto.used_features
+        row0 = 0
+        for s in seqs:
+            bs = int(getattr(s, "batch_size", 4096) or 4096)
+            for lo in range(0, len(s), bs):
+                chunk = np.asarray(s[lo : lo + bs], np.float64)
+                if chunk.ndim == 1:
+                    chunk = chunk.reshape(1, -1)
+                sub = np.empty((len(used), chunk.shape[0]), dtype=dtype)
+                for i, f in enumerate(used):
+                    sub[i] = proto.mappers[f].values_to_bins(
+                        chunk[:, f]
+                    ).astype(dtype)
+                if proto.bundle_layout is not None:
+                    from .bundling import encode
+
+                    um = [proto.mappers[f] for f in used]
+                    sub, _ = encode(
+                        sub, proto.bundle_layout,
+                        [m.num_bin for m in um],
+                        [m.most_freq_bin for m in um],
+                        dtype,
+                    )
+                bins[:, row0 : row0 + chunk.shape[0]] = sub
+                row0 += chunk.shape[0]
+        meta = Metadata(
+            label=None if label is None else np.asarray(label, np.float32).ravel(),
+            weight=None if weight is None else np.asarray(weight, np.float32).ravel(),
+            group=None if group is None else np.asarray(group, np.int64).ravel(),
+            init_score=None if init_score is None else np.asarray(init_score, np.float64).ravel(),
+            position=None if position is None else np.asarray(position, np.int32).ravel(),
+        )
+        meta.check(total)
+        return BinnedDataset(
+            bins=bins,
+            mappers=proto.mappers,
+            used_features=used,
+            num_data=total,
+            metadata=meta,
+            feature_names=list(proto.feature_names),
+            max_num_bin=proto.max_num_bin,
+            row_block=proto.row_block,
+            monotone_constraints=proto.monotone_constraints,
+            raw_data=None,
+            bundle_layout=proto.bundle_layout,
+            bundle_expand=proto.bundle_expand,
+        )
+
     def copy_subrow(self, indices: np.ndarray) -> "BinnedDataset":
         """Row subset sharing bin mappers (reference Dataset::CopySubrow,
         dataset.h — used by bagging-subset and python Dataset.subset)."""

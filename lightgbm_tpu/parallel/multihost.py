@@ -1,0 +1,128 @@
+"""Multi-host training support (the reference's distributed runtime).
+
+The reference runs one CLI process per machine connected by a
+hand-rolled socket/MPI collective layer (src/network/linkers_socket.cpp
+full-mesh TCP, network.cpp ring/halving collectives). The TPU-native
+equivalent is JAX's multi-controller runtime: one process per host,
+`jax.distributed.initialize` forms the cluster, and every collective in
+the growers (psum / all_gather / psum_scatter) rides ICI within a slice
+and DCN across hosts through the SAME code path as single-host — no
+separate network layer.
+
+This module maps the reference's network configuration
+(`machines` / `machine_list_filename` / `num_machines` /
+`local_listen_port`, config.h network params; python
+`lgb.set_network`) onto `jax.distributed.initialize`, and provides the
+pre-partitioned data assembly (`pre_partition=true` semantics,
+dataset_loader.cpp:210: each rank holds its own row shard):
+
+- `init_distributed(...)`: join/form the cluster.
+- `allgather_binning_sample(sample)`: the reference's distributed
+  binning (dataset_loader.cpp:1174: per-rank FindBin samples are
+  allgathered so every rank builds IDENTICAL bin mappers).
+- `global_rows(host_array, mesh, row_axis)`: assemble a process-local
+  row shard into one global device array over the mesh
+  (jax.make_array_from_process_local_data).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def resolve_rank(machines: Sequence[str], local_listen_port: int) -> int:
+    """Best-effort self-rank discovery by local address match (the
+    reference matches local IPs against the machine list,
+    linkers_socket.cpp:38-49); falls back to the JAX_PROCESS_ID env."""
+    import os
+    import socket
+
+    env = os.environ.get("JAX_PROCESS_ID")
+    if env is not None:
+        return int(env)
+    local_names = {socket.gethostname(), "localhost", "127.0.0.1"}
+    try:
+        local_names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    for i, m in enumerate(machines):
+        host, _, port = m.partition(":")
+        if host in local_names and (not port or int(port) == local_listen_port):
+            return i
+    raise RuntimeError(
+        "cannot determine this process's rank: no machine entry matches a "
+        "local address; set JAX_PROCESS_ID or pass machine_rank"
+    )
+
+
+def init_distributed(
+    machines: Optional[str] = None,
+    machine_list_file: Optional[str] = None,
+    num_machines: Optional[int] = None,
+    local_listen_port: int = 12400,
+    machine_rank: Optional[int] = None,
+) -> int:
+    """Join the multi-host cluster from reference-style network params.
+
+    The first machine in the list is the coordinator (the reference has
+    no coordinator — its socket mesh is symmetric — but rank 0 is the
+    canonical choice). Returns this process's rank. No-op when the
+    cluster is already initialized.
+    """
+    import jax
+
+    # NOTE: no jax.process_count()/devices() probe here — touching the
+    # backend before jax.distributed.initialize() poisons it
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        return jax.process_index()
+    mlist = []
+    if machine_list_file:
+        with open(machine_list_file) as f:
+            mlist = [ln.strip() for ln in f if ln.strip()]
+    elif machines:
+        mlist = [m.strip() for m in machines.split(",") if m.strip()]
+    if not mlist:
+        raise ValueError("init_distributed needs machines or machine_list_file")
+    n = num_machines or len(mlist)
+    rank = machine_rank if machine_rank is not None else resolve_rank(
+        mlist, local_listen_port
+    )
+    coord = mlist[0]
+    if ":" not in coord:
+        coord = f"{coord}:{local_listen_port}"
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=n, process_id=rank
+    )
+    return rank
+
+
+def allgather_binning_sample(sample: np.ndarray) -> np.ndarray:
+    """Concatenate every process's binning sample (rows) so all ranks
+    derive identical BinMappers (dataset_loader.cpp:1174-1250)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return sample
+    gathered = multihost_utils.process_allgather(sample)
+    return np.asarray(gathered).reshape(-1, sample.shape[-1])
+
+
+def global_rows(arr: np.ndarray, mesh, axis: int = 0):
+    """Assemble per-process row shards into one global array sharded
+    over the mesh's 'data' axis (pre_partition semantics: this
+    process's rows are its shard; shards concatenate in process order).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * arr.ndim
+    spec[axis] = "data"
+    sharding = NamedSharding(mesh, P(*spec))
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, arr)

@@ -1,0 +1,73 @@
+"""Arrow ingest (reference include/LightGBM/arrow.h, c_api.cpp:1645,
+tests/python_package_test/test_arrow.py patterns): pyarrow Tables and
+arrays feed Dataset/predict like numpy."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+import lightgbm_tpu as lgb
+
+
+def _table(n=800, seed=0):
+    rs = np.random.RandomState(seed)
+    cols = {f"f{i}": rs.randn(n) for i in range(5)}
+    y = ((cols["f0"] + cols["f1"] + 0.3 * rs.randn(n)) > 0).astype(np.float64)
+    return pa.table(cols), pa.array(y), np.column_stack(list(cols.values())), y
+
+
+def test_dataset_from_arrow_table_matches_numpy():
+    table, ay, X, y = _table()
+    d_arrow = lgb.Dataset(table, label=ay, free_raw_data=False)
+    d_numpy = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = dict(objective="binary", num_leaves=15, verbosity=-1)
+    b1 = lgb.train(params, d_arrow, num_boost_round=5)
+    b2 = lgb.train(params, d_numpy, num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-6)
+    # feature names come from the table
+    assert b1.feature_name()[:2] == ["f0", "f1"]
+
+
+def test_arrow_nulls_become_nan():
+    t = pa.table({
+        "a": pa.array([1.0, None, 3.0, None, 5.0] * 40),
+        "b": pa.array(list(np.arange(200.0))),
+    })
+    y = np.arange(200.0)
+    ds = lgb.Dataset(t, label=y, free_raw_data=False)
+    ds.construct()
+    assert ds._binned.num_data == 200
+
+
+def test_predict_on_arrow_table():
+    table, ay, X, y = _table(seed=3)
+    ds = lgb.Dataset(table, label=ay, free_raw_data=False)
+    bst = lgb.train(
+        dict(objective="regression", num_leaves=7, verbosity=-1),
+        ds, num_boost_round=3,
+    )
+    np.testing.assert_allclose(
+        bst.predict(table), bst.predict(X), rtol=1e-12
+    )
+
+
+def test_arrow_weight_and_group():
+    rs = np.random.RandomState(5)
+    n_q, docs = 40, 5
+    n = n_q * docs
+    X = rs.randn(n, 4)
+    y = rs.randint(0, 3, n).astype(np.float64)
+    ds = lgb.Dataset(
+        pa.table({f"c{i}": X[:, i] for i in range(4)}),
+        label=pa.array(y),
+        weight=pa.array(np.ones(n)),
+        group=pa.array(np.full(n_q, docs, np.int64)),
+        free_raw_data=False,
+    )
+    bst = lgb.train(
+        {"objective": "lambdarank", "num_leaves": 7, "min_data_in_leaf": 3,
+         "verbosity": -1},
+        ds, num_boost_round=3,
+    )
+    assert bst.num_trees() == 3

@@ -1,0 +1,105 @@
+"""Coverage the reference suite has that ours lacked (VERDICT r2 weak
+#9): weighted training, large-leaf (255) trees, multiclass through the
+fused loop."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_weighted_training_shifts_model():
+    rs = np.random.RandomState(2)
+    n = 4000
+    X = rs.randn(n, 5)
+    y = ((X[:, 0] + 0.3 * rs.randn(n)) > 0).astype(np.float64)
+    # upweight the positive class 10x — predictions must shift up
+    w = np.where(y > 0, 10.0, 1.0)
+    params = dict(objective="binary", num_leaves=15, verbosity=-1)
+    b0 = lgb.train(params, lgb.Dataset(X, label=y, free_raw_data=False),
+                   num_boost_round=10)
+    b1 = lgb.train(params, lgb.Dataset(X, label=y, weight=w,
+                                       free_raw_data=False),
+                   num_boost_round=10)
+    assert b1.predict(X).mean() > b0.predict(X).mean() + 0.05
+    # weighted metric eval runs
+    rec = {}
+    ds = lgb.Dataset(X, label=y, weight=w, free_raw_data=False)
+    lgb.train({**params, "metric": "binary_logloss"}, ds, num_boost_round=5,
+              valid_sets=[ds], valid_names=["t"],
+              callbacks=[lgb.record_evaluation(rec)])
+    assert len(rec["t"]["binary_logloss"]) == 5
+
+
+def test_large_leaf_255_tree():
+    """One 255-leaf tree at the benchmark's leaf budget (the while_loop
+    capacity ladder must handle deep growth)."""
+    rs = np.random.RandomState(3)
+    n = 20000
+    X = rs.randn(n, 8)
+    y = X[:, 0] * np.sin(X[:, 1] * 2) + 0.5 * X[:, 2] ** 2 + 0.05 * rs.randn(n)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 255,
+         "min_data_in_leaf": 20, "learning_rate": 0.5, "verbosity": -1},
+        ds, num_boost_round=3,
+    )
+    t = bst._gbdt.models[0]
+    assert t.num_leaves > 200  # rich signal: near-full budget used
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < float(np.var(y)) * 0.4
+
+
+def test_multiclass_fused_loop():
+    rs = np.random.RandomState(4)
+    n = 6000
+    X = rs.randn(n, 6)
+    logits = np.stack([X[:, 0], X[:, 1], -(X[:, 0] + X[:, 1])], 1)
+    y = np.argmax(logits + 0.3 * rs.randn(n, 3), axis=1).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    vs = lgb.Dataset(X[:1000], label=y[:1000], reference=ds,
+                     free_raw_data=False)
+    rec = {}
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+         "metric": "multi_logloss", "verbosity": -1},
+        ds, num_boost_round=8, valid_sets=[vs], valid_names=["v"],
+        callbacks=[lgb.record_evaluation(rec)],
+    )
+    assert bst._gbdt.fused_eligible()  # device metric set covers this
+    p = bst.predict(X)
+    assert p.shape == (n, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
+    assert (np.argmax(p, 1) == y).mean() > 0.7
+    assert rec["v"]["multi_logloss"][-1] < rec["v"]["multi_logloss"][0]
+
+
+def test_sequence_streaming_construction():
+    """lgb.Sequence streaming ingest (reference basic.py:905): binned
+    matrix built in chunks matches the all-at-once numpy path."""
+    rs = np.random.RandomState(9)
+    X = rs.randn(3000, 5)
+    y = ((X[:, 0] + 0.5 * X[:, 2]) > 0).astype(np.float64)
+
+    class ArrSeq(lgb.Sequence):
+        batch_size = 256
+
+        def __init__(self, a):
+            self._a = a
+
+        def __len__(self):
+            return len(self._a)
+
+        def __getitem__(self, idx):
+            return self._a[idx]
+
+    params = dict(objective="binary", num_leaves=15, verbosity=-1)
+    # split across two sequences to exercise multi-sequence concat
+    ds_seq = lgb.Dataset([ArrSeq(X[:1000]), ArrSeq(X[1000:])], label=y)
+    ds_np = lgb.Dataset(X, label=y, free_raw_data=False)
+    ds_seq.construct()
+    ds_np.construct()
+    np.testing.assert_array_equal(ds_seq._binned.bins, ds_np._binned.bins)
+    b1 = lgb.train(params, ds_seq, num_boost_round=5)
+    b2 = lgb.train(params, ds_np, num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-6)

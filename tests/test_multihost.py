@@ -1,0 +1,56 @@
+"""Multi-host distributed training (reference src/network/ socket
+cluster -> jax.distributed multi-controller; SURVEY §2.8).
+
+Spawns two REAL processes connected by jax.distributed (Gloo CPU
+collectives standing in for DCN), each holding half the rows
+(pre_partition), allgathering binning samples, and growing one tree
+through the data-parallel grower — both ranks must produce the
+identical tree (the reference's lockstep guarantee)."""
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(600)
+def test_two_process_data_parallel_lockstep():
+    worker = Path(__file__).parent / "_multihost_worker.py"
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {i} failed:\n{out[-2000:]}"
+        assert "MULTIHOST_OK" in out, out[-2000:]
+    # both ranks report the same tree
+    lines = [
+        next(ln for ln in out.splitlines() if ln.startswith("MULTIHOST_OK"))
+        for out in outs
+    ]
+    sig = [ln.split("nodes=")[1] for ln in lines]
+    assert sig[0] == sig[1], lines
