@@ -57,6 +57,70 @@ def _jit_traverse():
     return jax.jit(traverse_tree_bins)
 
 
+def _load_forced_splits(path: str, ds: "BinnedDataset"):
+    """Read a forcedsplits json into a BFS plan (ForceSplits,
+    serial_tree_learner.cpp:627): each node {feature, threshold,
+    left?, right?}; thresholds map to bins via the feature's mapper.
+    Returns a learner ForcedSplits or None on any problem (warned)."""
+    import json as _json
+
+    import jax.numpy as jnp
+
+    from .binning import BinType
+    from .learner.permuted import ForcedSplits
+
+    try:
+        with open(path) as f:
+            root = _json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning(f"cannot read forcedsplits_filename {path}: {e}")
+        return None
+    used_pos = {int(f): i for i, f in enumerate(ds.used_features)}
+    from collections import deque
+
+    leaves, feats, bins_ = [], [], []
+    q = deque([(root, 0)])
+    i = 0
+    while q:
+        node, leaf = q.popleft()
+        if not isinstance(node, dict) or "feature" not in node:
+            continue
+        f_orig = int(node["feature"])
+        if f_orig not in used_pos:
+            log.warning(
+                f"forced split on unused/trivial feature {f_orig}; "
+                "skipping this branch"
+            )
+            continue
+        m = ds.mappers[f_orig]
+        if m.bin_type == BinType.CATEGORICAL:
+            log.warning(
+                "forced splits on categorical features are not supported; "
+                f"skipping feature {f_orig}"
+            )
+            continue
+        thr = float(node.get("threshold", 0.0))
+        b = int(np.searchsorted(m.upper_bounds, thr, side="left"))
+        b = min(b, max(m.num_bin - 2, 0))
+        leaves.append(leaf)
+        feats.append(used_pos[f_orig])
+        bins_.append(b)
+        new_leaf = i + 1  # right child's leaf id (Tree::Split numbering)
+        if isinstance(node.get("left"), dict):
+            q.append((node["left"], leaf))
+        if isinstance(node.get("right"), dict):
+            q.append((node["right"], new_leaf))
+        i += 1
+    if not leaves:
+        return None
+    return ForcedSplits(
+        leaf=jnp.asarray(leaves, jnp.int32),
+        feature=jnp.asarray(feats, jnp.int32),
+        bin=jnp.asarray(bins_, jnp.int32),
+        n=jnp.int32(len(leaves)),
+    )
+
+
 class GBDT:
     """Training driver (reference gbdt.h:37)."""
 
@@ -236,6 +300,18 @@ class GBDT:
                 # (is_feature_used_in_split_); the fused loop cannot see
                 # cross-iteration feature usage, so run synchronously
                 self._force_sync = True
+        # forced splits (forcedsplits_filename, serial_tree_learner.cpp
+        # ForceSplits): read the BFS plan once; leaf ids at application
+        # time are precomputed (left child keeps the parent id, right
+        # child gets i+1 — Tree::Split numbering)
+        self._forced = None
+        n_forced = 0
+        if config.forcedsplits_filename:
+            self._forced = _load_forced_splits(
+                config.forcedsplits_filename, train_set
+            )
+            if self._forced is not None:
+                n_forced = int(self._forced.leaf.shape[0])
         if config.linear_tree:
             # leaf ridge fits run host-side per iteration (the reference
             # solves with Eigen on CPU too, linear_tree_learner.cpp:344)
@@ -272,12 +348,14 @@ class GBDT:
             col_bins=train_set.col_bins,
             rounds=(config.tpu_growth_rounds and not use_voting
                     and self._parallel_mode != "feature"
-                    and not (use_extra or use_bynode or use_cegb or n_groups)),
+                    and not (use_extra or use_bynode or use_cegb or n_groups
+                             or n_forced)),
             voting_k=config.top_k if use_voting else 0,
             extra_trees=use_extra,
             ff_bynode=use_bynode,
             cegb=use_cegb,
             n_groups=n_groups,
+            n_forced=n_forced,
         )
         self.params = make_split_params(config)
         self.train = _ScoreSet(
@@ -405,12 +483,14 @@ class GBDT:
                 d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
                 gk, hk, mask, feat_mask, self.params, valid,
                 d.get("bundle"), rng_key, self._group_mat, self._cegb_info,
+                self._forced,
             )
         return grow_tree(
             d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
             gk, hk, mask, feat_mask, self.params, self.spec, valid=valid,
             bundle=d.get("bundle"), rng_key=rng_key,
             group_mat=self._group_mat, cegb=self._cegb_info,
+            forced=self._forced,
         )
 
     # ------------------------------------------------------------------

@@ -399,10 +399,7 @@ class Config:
 # honest parameter surface: accepted-but-not-yet-implemented params warn
 # loudly instead of silently doing nothing (VERDICT r2 weak #5)
 # ---------------------------------------------------------------------------
-_UNIMPLEMENTED = (
-    # (name, inactive_value, message)
-    ("forcedsplits_filename", "", "forced splits are not implemented yet"),
-)
+_UNIMPLEMENTED = ()  # every accepted parameter now has effect
 
 
 def parse_interaction_constraints(s: str, num_features: int):

@@ -106,6 +106,8 @@ class GrowerSpec(NamedTuple):
     cegb: bool = False
     # number of interaction-constraint groups (0 = unconstrained)
     n_groups: int = 0
+    # static length of the forced-split plan (forcedsplits_filename)
+    n_forced: int = 0
 
 
 class CegbInfo(NamedTuple):
@@ -263,6 +265,7 @@ def grow_tree(
     rng_key: Optional[jax.Array] = None,  # extra_trees / ff_bynode sampling
     group_mat: Optional[jax.Array] = None,  # (NG, F) bool — interaction groups
     cegb: Optional[CegbInfo] = None,
+    forced: Optional[Any] = None,  # ForcedSplits plan
 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (tree arrays, per-row leaf assignment).
 
@@ -274,9 +277,11 @@ def grow_tree(
 
         return grow_tree_permuted(
             bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-            feat_mask, params, spec, valid, bundle, rng_key, group_mat, cegb
+            feat_mask, params, spec, valid, bundle, rng_key, group_mat, cegb,
+            forced
         )
-    if spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups:
+    if (spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups
+            or spec.n_forced):
         raise ValueError(
             "extra_trees / feature_fraction_bynode / cegb / interaction "
             "constraints ride the permuted grower only"

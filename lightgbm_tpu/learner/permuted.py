@@ -45,8 +45,21 @@ from .split import (
     SplitRecord,
     best_split,
     feature_best_gains,
+    leaf_gain,
     leaf_output,
 )
+
+
+class ForcedSplits(NamedTuple):
+    """Traced forced-split plan (serial_tree_learner.cpp:627
+    ForceSplits): BFS-ordered (leaf, feature, bin) triples applied
+    before best-gain growth; `n` is the actual count (arrays padded to
+    a static length)."""
+
+    leaf: jax.Array  # (K,) int32 — leaf id at application time
+    feature: jax.Array  # (K,) int32 — used-feature index
+    bin: jax.Array  # (K,) int32 — threshold bin
+    n: jax.Array  # scalar int32
 from .grower import (
     CegbInfo,
     GrowerSpec,
@@ -160,6 +173,7 @@ def grow_tree_permuted(
     rng_key: Optional[jax.Array] = None,
     group_mat: Optional[jax.Array] = None,  # (NG, F) bool
     cegb: Optional[CegbInfo] = None,
+    forced: Optional[ForcedSplits] = None,
 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (tree arrays, natural-order row->leaf)."""
     L = spec.num_leaves
@@ -172,7 +186,7 @@ def grow_tree_permuted(
     if spec.voting_k and spec.efb:
         raise ValueError("voting_k requires EFB off (feature==column)")
     per_node = spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups
-    if spec.rounds and per_node:
+    if spec.rounds and (per_node or spec.n_forced):
         raise ValueError("tpu_growth_rounds excludes per-node extras")
 
     def node_candidates(salt, child_groups, path_used_child, child_count,
@@ -546,14 +560,70 @@ def grow_tree_permuted(
         rstate = lax.while_loop(_round_cond, _round_body, rstate)
         state = rstate.p
 
+    def _forced_valid(s: _PState):
+        """Is step s.i a forced split with both children non-empty?"""
+        fi = jnp.minimum(s.i, spec.n_forced - 1)
+        fl = forced.leaf[fi]
+        ff = forced.feature[fi]
+        fb = forced.bin[fi]
+        fh = exp_hist(s.hist[fl], s.leaf_g[fl], s.leaf_h[fl], s.leaf_c[fl])
+        lc = jnp.cumsum(fh[2, ff])[fb]
+        return (s.i < forced.n) & (lc > 0) & (s.leaf_c[fl] - lc > 0)
+
     def cond(s: _PState) -> jax.Array:
-        return (s.i < L - 1) & (jnp.max(s.best.gain) > 0.0)
+        keep = jnp.max(s.best.gain) > 0.0
+        if spec.n_forced:
+            # only continue for a forced step that can actually split
+            # (both children non-empty) — the body falls back to the
+            # best-gain split otherwise, which `keep` already guards
+            keep = keep | _forced_valid(s)
+        return (s.i < L - 1) & keep
 
     def body(s: _PState) -> _PState:
         i = s.i
         t = s.tree
         l = jnp.argmax(s.best.gain).astype(jnp.int32)
         rec = _get_best(s.best, l)
+        if spec.n_forced:
+            # forced splits (ForceSplits, serial_tree_learner.cpp:627):
+            # the first `forced.n` steps split prescribed leaves at
+            # prescribed (feature, threshold-bin), skipping any that
+            # would leave an empty child (the reference aborts invalid
+            # forced branches)
+            fi = jnp.minimum(i, spec.n_forced - 1)
+            fl = forced.leaf[fi]
+            ff = forced.feature[fi]
+            fb = forced.bin[fi]
+            fh = exp_hist(s.hist[fl], s.leaf_g[fl], s.leaf_h[fl],
+                          s.leaf_c[fl])
+            cg = jnp.cumsum(fh[0, ff])
+            chs = jnp.cumsum(fh[1, ff])
+            cc = jnp.cumsum(fh[2, ff])
+            lg, lh, lc = cg[fb], chs[fb], cc[fb]
+            pg, ph, pc = s.leaf_g[fl], s.leaf_h[fl], s.leaf_c[fl]
+            gain_f = (
+                leaf_gain(lg, lh, params) + leaf_gain(pg - lg, ph - lh, params)
+                - leaf_gain(pg, ph, params)
+            )
+            # invalid forced entries (empty child / exhausted plan) fall
+            # back to the best-gain split; the cond guarantees that
+            # fallback has positive gain. NOTE: after a skipped invalid
+            # entry, later forced entries still target their
+            # PRE-COMPUTED leaf ids (the reference re-maps by aborting
+            # the branch queue — documented deviation for invalid plans)
+            use = (i < forced.n) & (lc > 0) & (pc - lc > 0)
+            rec_f = SplitRecord(
+                gain=gain_f, feature=ff, bin=fb,
+                default_left=jnp.asarray(False),
+                is_cat=jnp.asarray(False),
+                cat_mask=jnp.zeros(B, bool),
+                left_g=lg, left_h=lh, left_c=lc,
+                right_g=pg - lg, right_h=ph - lh, right_c=pc - lc,
+            )
+            l = jnp.where(use, fl, l)
+            rec = jax.tree.map(
+                lambda a, b: jnp.where(use, a, b), rec_f, rec
+            )
         new = i + 1
 
         # ---- tree bookkeeping (Tree::Split semantics, same as flat) ----

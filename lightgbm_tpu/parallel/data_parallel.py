@@ -55,11 +55,13 @@ class DataParallelGrower:
         rep = P()
 
         def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
-               feat_mask, params, valid, bundle, rng_key, group_mat, cegb):
+               feat_mask, params, valid, bundle, rng_key, group_mat, cegb,
+               forced):
             tree, row_leaf = grow_tree(
                 bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
                 feat_mask, params, self.spec, valid=valid, bundle=bundle,
                 rng_key=rng_key, group_mat=group_mat, cegb=cegb,
+                forced=forced,
             )
             # tree state is identical on all shards (computed from psum'd
             # histograms); mark it replicated for the out_spec
@@ -67,7 +69,7 @@ class DataParallelGrower:
             return tree, row_leaf
 
         in_specs = (bins_spec, rep, rep, rep, rep, row, row, row, rep, rep,
-                    row, rep, rep, rep, rep)
+                    row, rep, rep, rep, rep, rep)
         out_specs = (jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)), row)
         self._fn = jax.jit(
             jax.shard_map(
@@ -81,11 +83,11 @@ class DataParallelGrower:
 
     def __call__(self, bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
                  feat_mask, params: SplitParams, valid, bundle=None,
-                 rng_key=None, group_mat=None, cegb=None,
+                 rng_key=None, group_mat=None, cegb=None, forced=None,
                  ) -> Tuple[TreeArrays, jax.Array]:
         return self._fn(
             bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask,
-            params, valid, bundle, rng_key, group_mat, cegb,
+            params, valid, bundle, rng_key, group_mat, cegb, forced,
         )
 
     def shard_inputs(self, dev: dict) -> dict:

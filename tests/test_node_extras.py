@@ -110,3 +110,41 @@ def test_cegb_coupled_penalty_avoids_expensive_feature():
     for t in b._gbdt.models:
         for p in _tree_paths(t):
             assert 0 not in p, "penalized feature was used"
+
+
+def test_forced_splits(tmp_path):
+    """forcedsplits_filename (serial_tree_learner.cpp:627 ForceSplits):
+    the tree's first splits follow the json plan exactly."""
+    import json
+
+    X, y = _problem(f=4, seed=6)
+    plan = {
+        "feature": 2,
+        "threshold": 0.0,
+        "left": {"feature": 1, "threshold": 0.5},
+        "right": {"feature": 3, "threshold": -0.25},
+    }
+    p = tmp_path / "forced.json"
+    p.write_text(json.dumps(plan))
+    b = _train(
+        {**BASE, "forcedsplits_filename": str(p)}, X, y, rounds=2,
+    )
+    for t in b._gbdt.models:
+        # split 0: root forced on feature 2 at ~0.0
+        assert int(t.split_feature[0]) == 2
+        assert abs(float(t.threshold[0])) < 0.2
+        # split 1 = left child (leaf 0) forced on feature 1; split 2 =
+        # right child (leaf 1) forced on feature 3
+        assert int(t.split_feature[1]) == 1
+        assert int(t.split_feature[2]) == 3
+        # node 0's children are the forced internal nodes
+        assert int(t.left_child[0]) == 1
+        assert int(t.right_child[0]) == 2
+
+
+def test_forced_splits_invalid_file_warns(tmp_path, capsys):
+    X, y = _problem(seed=7)
+    p = tmp_path / "nope.json"
+    b = _train({**BASE, "forcedsplits_filename": str(p), "verbosity": 0},
+               X, y, rounds=1)
+    assert b.num_trees() == 1  # training proceeds without forcing
