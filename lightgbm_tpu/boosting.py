@@ -312,6 +312,20 @@ class GBDT:
             )
             if self._forced is not None:
                 n_forced = int(self._forced.leaf.shape[0])
+        if n_forced and use_voting:
+            # under voting only ELECTED feature columns of the pooled
+            # histogram hold globally-reduced values; a forced split
+            # reads its prescribed feature's column unconditionally and
+            # would consume stale per-shard sums below the root —
+            # disable the election rather than train wrong trees
+            # (same shape as the EFB guard above)
+            log.warning(
+                "tree_learner=voting is disabled because a forced-split "
+                "plan (forcedsplits_filename) reads histogram columns "
+                "the election would not reduce; falling back to full "
+                "histogram psum (tree_learner=data)."
+            )
+            use_voting = False
         if config.linear_tree:
             # leaf ridge fits run host-side per iteration (the reference
             # solves with Eigen on CPU too, linear_tree_learner.cpp:344)
@@ -334,10 +348,48 @@ class GBDT:
             use_extra = use_bynode = use_cegb = False
             n_groups = 0
             self._cegb_info = self._group_mat = None
+        if n_forced and self._parallel_mode == "feature":
+            # the feature-parallel grower rides the flat partition which
+            # has no forced-split support — dropping the plan (with a
+            # warning) beats crashing at the first iteration
+            log.warning(
+                "forcedsplits_filename is not supported with "
+                "tree_learner=feature; ignoring the forced-split plan"
+            )
+            self._forced = None
+            n_forced = 0
         self._node_key = (
             jax.random.key(config.extra_seed) if (use_extra or use_bynode)
             else None
         )
+        # ---- growth strategy (tpu_growth_mode): natural-order
+        # round-batched growth is the TPU fast path; per-node extras,
+        # forced splits, voting and feature-parallel ride the sequential
+        # permuted grower (rounds.py module docstring has the semantics)
+        rounds_ok = (
+            not use_voting
+            and self._parallel_mode != "feature"
+            and not (use_extra or use_bynode or use_cegb or n_groups
+                     or n_forced)
+        )
+        mode = config.tpu_growth_mode
+        if mode == "auto":
+            try:
+                on_tpu = jax.devices()[0].platform == "tpu"
+            except Exception:  # noqa: BLE001
+                on_tpu = False
+            use_rounds = on_tpu and rounds_ok
+        else:
+            use_rounds = mode == "rounds"
+            if use_rounds and not rounds_ok:
+                log.warning(
+                    "tpu_growth_mode=rounds is incompatible with "
+                    "extra_trees / feature_fraction_bynode / cegb / "
+                    "interaction_constraints / forced splits / voting / "
+                    "tree_learner=feature; falling back to exact "
+                    "sequential growth"
+                )
+                use_rounds = False
         self.spec = GrowerSpec(
             num_leaves=config.num_leaves,
             num_bins=train_set.max_num_bin,
@@ -346,10 +398,12 @@ class GBDT:
             cat_subset=cat_subset,
             efb=train_set.bundle_layout is not None,
             col_bins=train_set.col_bins,
-            rounds=(config.tpu_growth_rounds and not use_voting
-                    and self._parallel_mode != "feature"
-                    and not (use_extra or use_bynode or use_cegb or n_groups
-                             or n_forced)),
+            rounds=(config.tpu_growth_rounds and not use_rounds
+                    and rounds_ok),
+            rounds_slots=(
+                min(config.tpu_round_slots, config.num_leaves)
+                if use_rounds else 0
+            ),
             voting_k=config.top_k if use_voting else 0,
             extra_trees=use_extra,
             ff_bynode=use_bynode,

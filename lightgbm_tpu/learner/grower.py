@@ -108,6 +108,12 @@ class GrowerSpec(NamedTuple):
     n_groups: int = 0
     # static length of the forced-split plan (forcedsplits_filename)
     n_forced: int = 0
+    # natural-order round-batched growth (rounds.py, tpu_growth_mode):
+    # > 0 = split the top-`rounds_slots` positive-gain leaves per device
+    # step, smaller-child histograms from ONE slot-packed MXU pass keyed
+    # by the row->leaf vector — no physical row movement at all. The TPU
+    # fast path; 0 = off (sequential permuted growth).
+    rounds_slots: int = 0
 
 
 class CegbInfo(NamedTuple):
@@ -269,9 +275,18 @@ def grow_tree(
 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (tree arrays, per-row leaf assignment).
 
-    Dispatches on spec.partition: "permuted" (leaf-grouped rows,
-    O(segment) per split — production) or "flat" (per-row leaf ids,
+    Dispatches on spec.rounds_slots / spec.partition: "rounds"
+    (natural-order round-batched, rounds.py — the TPU fast path),
+    "permuted" (leaf-grouped rows, O(segment) per split — the
+    reference-exact production path) or "flat" (per-row leaf ids,
     O(N) per split — reference/debug)."""
+    if spec.rounds_slots > 0:
+        from .rounds import grow_tree_rounds
+
+        return grow_tree_rounds(
+            bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+            feat_mask, params, spec, valid, bundle,
+        )
     if spec.partition == "permuted":
         from .permuted import grow_tree_permuted
 

@@ -34,7 +34,78 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .histogram import CH, HIST_BLK
+from .histogram import CH, HIST_BLK, NAT_CH
+
+
+def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref, acc_ref,
+                *, F: int, B: int, blk: int, S: int):
+    """Slot-packed natural-order histogram: rows carry a slot id; the
+    weight matrix W packs (slot x channel) onto the MXU's M axis —
+    W[(s, c), r] = gh[c, r] * (slot[r] == s) — so one (S*NAT_CH, blk) @
+    (blk, B) matmul per feature accumulates ALL slots' histograms. With
+    S*NAT_CH ~ 125 of the MXU's 128 M rows useful, up to 25 slots cost
+    the wall time the single-leaf kernel spends on 8 rows."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    slot = slot_ref[0, :]  # (blk,) int32
+    gh = gh_ref[...]  # (CH, blk) f32; rows 0..NAT_CH-1 are live
+    iota_s = lax.broadcasted_iota(jnp.int32, (S, blk), 0)
+    sl = (slot[None, :] == iota_s).astype(jnp.bfloat16)  # (S, blk)
+    g5 = gh[:NAT_CH, :].astype(jnp.bfloat16)  # (NAT_CH, blk)
+    W = (sl[:, None, :] * g5[None, :, :]).reshape(S * NAT_CH, blk)
+
+    bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
+    iota_b = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
+    for f in range(F):
+        onehot = (bt[:, f : f + 1] == iota_b).astype(jnp.bfloat16)  # (blk, B)
+        acc_ref[:, f * B : (f + 1) * B] += jnp.dot(
+            W, onehot, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _flush():
+        out_ref[...] = acc_ref[...]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_slots", "num_bins", "blk", "interpret")
+)
+def hist_nat_tpu(
+    bins_fm: jax.Array,  # (F, N) int32, natural row order
+    gh8: jax.Array,  # (CH, N) f32
+    slot: jax.Array,  # (N,) int32 in [0, num_slots]
+    num_slots: int,
+    num_bins: int,
+    blk: int = HIST_BLK,
+    interpret: bool = False,
+) -> jax.Array:
+    """(S*NAT_CH, F*B) f32 packed per-slot channel histograms."""
+    F, N = bins_fm.shape
+    assert N % blk == 0, (N, blk)
+    assert gh8.shape == (CH, N), gh8.shape
+    B = num_bins
+    S = num_slots
+    nb = N // blk
+    out = pl.pallas_call(
+        functools.partial(_nat_kernel, F=F, B=B, blk=blk, S=S),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((F, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((CH, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (S * NAT_CH, F * B), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((S * NAT_CH, F * B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((S * NAT_CH, F * B), jnp.float32)],
+        interpret=interpret,
+    )(bins_fm, gh8, slot.reshape(1, N))
+    return out
 
 
 def _hist_kernel(bins_ref, gh_ref, out_ref, acc_ref, *, F: int, B: int, blk: int):
@@ -94,7 +165,9 @@ def _hist_slots_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_bins", "num_slots", "blk", "dense_visits")
+    jax.jit,
+    static_argnames=("num_bins", "num_slots", "blk", "dense_visits",
+                     "interpret"),
 )
 def hist_slots_tpu(
     bins_fm: jax.Array,  # (F, N) int32, rows POSITION-grouped by slot
@@ -105,6 +178,7 @@ def hist_slots_tpu(
     num_slots: int,
     blk: int = HIST_BLK,
     dense_visits: bool = False,
+    interpret: bool = False,
 ) -> jax.Array:
     """Per-slot histograms in ONE data pass: (num_slots+1, CH, F*B).
 
@@ -163,13 +237,15 @@ def hist_slots_tpu(
         functools.partial(_hist_slots_kernel, F=F, B=B, blk=blk),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S + 1, CH, F * B), jnp.float32),
+        interpret=interpret,
     )(vblock, vslot_s, vlo, vhi, bins_fm, gh8)
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "blk"))
+@functools.partial(jax.jit, static_argnames=("num_bins", "blk", "interpret"))
 def hist_tpu(
-    bins_fm: jax.Array, gh8: jax.Array, num_bins: int, blk: int = HIST_BLK
+    bins_fm: jax.Array, gh8: jax.Array, num_bins: int, blk: int = HIST_BLK,
+    interpret: bool = False,
 ) -> jax.Array:
     """(F, N) int32 bins + (CH, N) f32 channels -> (CH, F, B) f32.
 
@@ -191,5 +267,6 @@ def hist_tpu(
         out_specs=pl.BlockSpec((CH, F * B), lambda i: (0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((CH, F * B), jnp.float32),
         scratch_shapes=[pltpu.VMEM((CH, F * B), jnp.float32)],
+        interpret=interpret,
     )(bins_fm, gh8)
     return out.reshape(CH, F, B)

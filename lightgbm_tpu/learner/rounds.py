@@ -1,0 +1,346 @@
+"""Natural-order round-batched leaf-wise growth — the TPU fast path.
+
+The permuted grower (permuted.py) keeps rows physically leaf-grouped so
+each split costs O(segment) — but maintaining that layout costs one
+full-array gather per split or round (75-120 ms at 1M x 36 channels:
+TPUs have no vector-gather hardware, see BENCH_NOTES.md). This grower
+never moves a row:
+
+- the partition is a per-row leaf-id vector updated with elementwise
+  `where` (the reference CUDA data_index_to_leaf_index,
+  src/treelearner/cuda/cuda_data_partition.cu:113);
+- per round, the top-k positive-gain leaves split AT ONCE
+  (k = min(round_slots, remaining leaf budget)); the smaller child of
+  every split gets its histogram from ONE slot-packed MXU pass
+  (histogram.hist_nat_slots — the multi-leaf batching of the reference
+  CUDA kernel, cuda_histogram_constructor.cu:20), the larger sibling
+  by parent subtraction (serial_tree_learner.cpp:411);
+- per-tree device work is ~#rounds histogram passes plus O(N)
+  elementwise updates — no gathers, no sorts, no prefix sums.
+
+Semantics vs the reference's sequential best-first growth: splitting
+the top-k leaves of a round in parallel yields the SAME final tree as
+sequential greedy whenever the leaf budget does not bind (a leaf's best
+split is independent of every other leaf), and the same set of splits
+ordered differently otherwise — except near the budget boundary, where
+children created by this round's splits never compete against this
+round's remaining candidates. `tpu_growth_mode=exact` keeps the
+reference-exact sequential grower; this mode is the default on TPU
+hardware where the round batching is worth ~an order of magnitude
+(config.h has no analog — the reference CUDA learner batches histogram
+construction but still splits one leaf at a time).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .bundle import BundleInfo, decode_feature_bins, expand_hist
+from .histogram import build_gh8, hist_nat_slots, histogram, root_sums
+from .grower import (
+    GrowerSpec,
+    TreeArrays,
+    _empty_best,
+    _set_best,
+    monotone_child_intervals,
+    split_leaf_outputs,
+)
+from .split import NEG_INF, BIG, SplitParams, SplitRecord, best_split, leaf_output
+
+
+class _NState(NamedTuple):
+    i: jax.Array  # splits performed so far
+    pleaf: jax.Array  # (N,) int32 row -> leaf; invalid rows carry L
+    hist: jax.Array  # (L, 3, G, Bc) histogram pool
+    leaf_g: jax.Array
+    leaf_h: jax.Array
+    leaf_c: jax.Array
+    leaf_parent: jax.Array
+    leaf_min: jax.Array  # monotone interval per leaf
+    leaf_max: jax.Array
+    best: SplitRecord  # per-leaf best splits, fields (L,)
+    tree: TreeArrays
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def grow_tree_rounds(
+    bins_fm: jax.Array,  # (G, N) int32, natural row order
+    nan_bin: jax.Array,
+    num_bins: jax.Array,
+    mono: jax.Array,
+    is_cat: jax.Array,
+    grad: jax.Array,
+    hess: jax.Array,
+    mask: jax.Array,  # validity * bagging
+    feat_mask: jax.Array,
+    params: SplitParams,
+    spec: GrowerSpec,
+    valid: Optional[jax.Array] = None,
+    bundle: Optional[BundleInfo] = None,
+) -> Tuple[TreeArrays, jax.Array]:
+    """Grow one tree; returns (tree arrays, natural-order row->leaf)."""
+    L = spec.num_leaves
+    B = spec.num_bins
+    G, N = bins_fm.shape  # G = device columns (bundles when spec.efb)
+    F = num_bins.shape[0]
+    S = spec.rounds_slots
+    ax = spec.axis_name
+    Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
+    if spec.voting_k:
+        raise ValueError("voting rides the permuted sequential grower")
+    if spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups \
+            or spec.n_forced:
+        raise ValueError(
+            "per-node extras / forced splits ride the permuted grower"
+        )
+
+    def exp_hist(h, g_sum, h_sum, c_sum):
+        if spec.efb:
+            return expand_hist(h, g_sum, h_sum, c_sum, bundle)
+        return h
+
+    gh8 = build_gh8(grad * mask, hess * mask, mask)  # (8, N)
+    root = root_sums(gh8, ax)
+    hist0 = histogram(bins_fm, gh8, Bc)
+    if ax is not None:
+        hist0 = lax.psum(hist0, ax)
+    root_out = leaf_output(root[0], root[1], params)
+    rec0 = best_split(exp_hist(hist0, root[0], root[1], root[2]),
+                      root[0], root[1], root[2], num_bins, nan_bin,
+                      mono, is_cat, params, feat_mask,
+                      cat_subset=spec.cat_subset, parent_output=root_out)
+
+    hist = jnp.zeros((L, 3, G, Bc), jnp.float32).at[0].set(hist0)
+    best = _set_best(_empty_best(L, B), jnp.int32(0), rec0, rec0.gain)
+
+    tree = TreeArrays(
+        num_nodes=jnp.int32(0),
+        node_feature=jnp.zeros(L - 1, jnp.int32),
+        node_bin=jnp.zeros(L - 1, jnp.int32),
+        node_gain=jnp.zeros(L - 1, jnp.float32),
+        node_default_left=jnp.zeros(L - 1, bool),
+        node_cat=jnp.zeros(L - 1, bool),
+        node_cat_mask=jnp.zeros((L - 1, B), bool),
+        node_left=jnp.zeros(L - 1, jnp.int32),
+        node_right=jnp.zeros(L - 1, jnp.int32),
+        node_value=jnp.zeros(L - 1, jnp.float32),
+        node_weight=jnp.zeros(L - 1, jnp.float32),
+        node_count=jnp.zeros(L - 1, jnp.float32),
+        leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
+        leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
+        leaf_count=jnp.zeros(L, jnp.float32).at[0].set(root[2]),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+    )
+
+    valid_f = jnp.ones(N, jnp.float32) if valid is None else valid
+    iota_L = jnp.arange(L, dtype=jnp.int32)
+    iota_S = jnp.arange(S, dtype=jnp.int32)
+
+    def body(s: _NState) -> _NState:
+        t = s.tree
+        i = s.i
+
+        # ---- select this round's splits: top-k by gain within budget.
+        # depth limits were already folded into best.gain when the
+        # children were scored. top_k returns gains sorted descending,
+        # so active slots form the prefix 0..n_split-1.
+        budget = (L - 1) - i
+        topv, topl = lax.top_k(s.best.gain, S)
+        take = (iota_S < jnp.minimum(budget, S)) & (topv > 0.0)
+        sel_leaf = jnp.where(take, topl, L)  # (S,) L = inactive slot
+        sel = jnp.zeros(L, bool).at[sel_leaf].set(True, mode="drop")
+        n_split = jnp.sum(take).astype(jnp.int32)
+        # rank = slot index per selected leaf (arbitrary but consistent)
+        rank = jnp.zeros(L, jnp.int32).at[sel_leaf].set(iota_S, mode="drop")
+        node_id = i + rank
+        new_id = i + 1 + rank
+        drop_node = jnp.where(sel, node_id, L - 1)  # L-1 -> mode=drop
+        drop_new = jnp.where(sel, new_id, L)
+
+        rec = s.best  # per-leaf records, fields (L,)
+
+        # ---- outputs / monotone intervals, vectorized over leaves ----
+        pmin, pmax = s.leaf_min, s.leaf_max
+        lo, ro = split_leaf_outputs(rec, params, num_bins, spec.cat_subset,
+                                    t.leaf_value, pmin, pmax)
+        lmin, lmax, rmin, rmax = monotone_child_intervals(
+            rec, mono, lo, ro, pmin, pmax
+        )
+        depth_new = t.leaf_depth + 1
+
+        # ---- tree bookkeeping (Tree::Split, batched) ----
+        p = s.leaf_parent
+        pc = jnp.maximum(p, 0)
+        p_is_left = t.node_left[pc] == ~iota_L
+        fix = sel & (p >= 0)
+        node_left = t.node_left.at[
+            jnp.where(fix & p_is_left, pc, L - 1)
+        ].set(node_id, mode="drop")
+        node_right = t.node_right.at[
+            jnp.where(fix & ~p_is_left, pc, L - 1)
+        ].set(node_id, mode="drop")
+        node_left = node_left.at[drop_node].set(~iota_L, mode="drop")
+        node_right = node_right.at[drop_node].set(~drop_new, mode="drop")
+
+        tree_new = TreeArrays(
+            num_nodes=i + n_split,
+            node_feature=t.node_feature.at[drop_node].set(rec.feature, mode="drop"),
+            node_bin=t.node_bin.at[drop_node].set(rec.bin, mode="drop"),
+            node_gain=t.node_gain.at[drop_node].set(rec.gain, mode="drop"),
+            node_default_left=t.node_default_left.at[drop_node].set(
+                rec.default_left, mode="drop"
+            ),
+            node_cat=t.node_cat.at[drop_node].set(rec.is_cat, mode="drop"),
+            node_cat_mask=t.node_cat_mask.at[drop_node].set(
+                rec.cat_mask, mode="drop"
+            ),
+            node_left=node_left,
+            node_right=node_right,
+            node_value=t.node_value.at[drop_node].set(t.leaf_value, mode="drop"),
+            node_weight=t.node_weight.at[drop_node].set(s.leaf_h, mode="drop"),
+            node_count=t.node_count.at[drop_node].set(s.leaf_c, mode="drop"),
+            leaf_value=jnp.where(sel, lo, t.leaf_value)
+            .at[drop_new].set(ro, mode="drop"),
+            leaf_weight=jnp.where(sel, rec.left_h, t.leaf_weight)
+            .at[drop_new].set(rec.right_h, mode="drop"),
+            leaf_count=jnp.where(sel, rec.left_c, t.leaf_count)
+            .at[drop_new].set(rec.right_c, mode="drop"),
+            leaf_depth=jnp.where(sel, depth_new, t.leaf_depth)
+            .at[drop_new].set(depth_new, mode="drop"),
+        )
+
+        # ---- per-row split decision for all selected leaves at once ----
+        pl_c = jnp.minimum(s.pleaf, L - 1)  # invalid rows -> dead lanes
+        f_row = rec.feature[pl_c]
+        col_row = bundle.bundle_of[f_row] if spec.efb else f_row
+        # masked select of each row's split column (no 2D gather)
+        col_sel = col_row[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]
+        fbins = jnp.sum(jnp.where(col_sel, bins_fm, 0), axis=0)
+        if spec.efb:
+            fbins = decode_feature_bins(fbins, f_row, bundle)
+        fnan_row = nan_bin[f_row]
+        cat_hit = rec.cat_mask.reshape(-1)[pl_c * B + jnp.minimum(fbins, B - 1)]
+        go_left = jnp.where(
+            rec.is_cat[pl_c],
+            cat_hit,
+            (fbins <= rec.bin[pl_c])
+            | (rec.default_left[pl_c] & (fbins == fnan_row) & (fnan_row >= 0)),
+        )
+        in_split = sel[pl_c] & (s.pleaf < L)
+        pleaf_new = jnp.where(
+            in_split & ~go_left, new_id[pl_c], s.pleaf
+        ).astype(jnp.int32)
+
+        # ---- smaller-child histograms: one slot-packed pass ----
+        # left/right counts are GLOBAL (derived from the psum'd parent
+        # histogram during split search), so the smaller-side choice is
+        # shard-consistent under data parallelism.
+        left_smaller = rec.left_c <= rec.right_c  # (L,)
+        go_small = go_left == left_smaller[pl_c]
+        hslot = jnp.where(in_split & go_small, rank[pl_c], S).astype(jnp.int32)
+        slot_hists = hist_nat_slots(bins_fm, gh8, hslot, S, Bc)  # (S,3,G,Bc)
+        if ax is not None:
+            slot_hists = lax.psum(slot_hists, ax)
+
+        # ---- per-slot child hists: smaller from the pass, larger by
+        # subtraction; scatter both into the pool. Work stays O(S), not
+        # O(L) — only the <= S split leaves are touched.
+        sl_c = jnp.minimum(sel_leaf, L - 1)  # (S,) clipped for gathers
+        parent_s = s.hist[sl_c]  # (S, 3, G, Bc)
+        large_s = parent_s - slot_hists
+        ls_s = left_smaller[sl_c][:, None, None, None]
+        left_s = jnp.where(ls_s, slot_hists, large_s)
+        right_s = jnp.where(ls_s, large_s, slot_hists)
+        new_id_s = jnp.where(take, i + 1 + iota_S, L)
+        hist = s.hist.at[sel_leaf].set(left_s, mode="drop")
+        hist = hist.at[new_id_s].set(right_s, mode="drop")
+
+        # ---- best splits for the new children, batched over 2S ----
+        def child_best(h, g_, h__, c_, po, cmn, cmx):
+            return best_split(
+                exp_hist(h, g_, h__, c_), g_, h__, c_, num_bins, nan_bin,
+                mono, is_cat, params, feat_mask,
+                cat_subset=spec.cat_subset, parent_output=po,
+                cmin=cmn, cmax=cmx,
+            )
+
+        vbest = jax.vmap(child_best)
+        ch_hist = jnp.concatenate([left_s, right_s])  # (2S, 3, G, Bc)
+        ch_g = jnp.concatenate([rec.left_g[sl_c], rec.right_g[sl_c]])
+        ch_h = jnp.concatenate([rec.left_h[sl_c], rec.right_h[sl_c]])
+        ch_c = jnp.concatenate([rec.left_c[sl_c], rec.right_c[sl_c]])
+        ch_po = jnp.concatenate([lo[sl_c], ro[sl_c]])
+        ch_mn = jnp.concatenate([lmin[sl_c], rmin[sl_c]])
+        ch_mx = jnp.concatenate([lmax[sl_c], rmax[sl_c]])
+        ch_rec = vbest(ch_hist, ch_g, ch_h, ch_c, ch_po, ch_mn, ch_mx)
+        depth_ok_s = (spec.max_depth <= 0) | (depth_new[sl_c] < spec.max_depth)
+        ch_gain = jnp.where(
+            jnp.concatenate([depth_ok_s, depth_ok_s]), ch_rec.gain, NEG_INF
+        )
+        ch_leaf = jnp.concatenate([sel_leaf, new_id_s])
+
+        def scat(dst, val):
+            return dst.at[ch_leaf].set(val, mode="drop")
+
+        best2 = SplitRecord(
+            gain=scat(s.best.gain, ch_gain),
+            feature=scat(s.best.feature, ch_rec.feature),
+            bin=scat(s.best.bin, ch_rec.bin),
+            default_left=scat(s.best.default_left, ch_rec.default_left),
+            is_cat=scat(s.best.is_cat, ch_rec.is_cat),
+            cat_mask=scat(s.best.cat_mask, ch_rec.cat_mask),
+            left_g=scat(s.best.left_g, ch_rec.left_g),
+            left_h=scat(s.best.left_h, ch_rec.left_h),
+            left_c=scat(s.best.left_c, ch_rec.left_c),
+            right_g=scat(s.best.right_g, ch_rec.right_g),
+            right_h=scat(s.best.right_h, ch_rec.right_h),
+            right_c=scat(s.best.right_c, ch_rec.right_c),
+        )
+
+        return _NState(
+            i=i + n_split,
+            pleaf=pleaf_new,
+            hist=hist,
+            leaf_g=jnp.where(sel, rec.left_g, s.leaf_g)
+            .at[drop_new].set(rec.right_g, mode="drop"),
+            leaf_h=jnp.where(sel, rec.left_h, s.leaf_h)
+            .at[drop_new].set(rec.right_h, mode="drop"),
+            leaf_c=jnp.where(sel, rec.left_c, s.leaf_c)
+            .at[drop_new].set(rec.right_c, mode="drop"),
+            leaf_parent=jnp.where(sel, node_id, s.leaf_parent)
+            .at[drop_new].set(node_id, mode="drop"),
+            leaf_min=jnp.where(sel, lmin, s.leaf_min)
+            .at[drop_new].set(rmin, mode="drop"),
+            leaf_max=jnp.where(sel, lmax, s.leaf_max)
+            .at[drop_new].set(rmax, mode="drop"),
+            best=best2,
+            tree=tree_new,
+        )
+
+    def cond(s: _NState) -> jax.Array:
+        return (s.i < L - 1) & (jnp.max(s.best.gain) > 0.0)
+
+    state = _NState(
+        i=jnp.int32(0),
+        pleaf=jnp.where(valid_f > 0, 0, L).astype(jnp.int32),
+        hist=hist,
+        leaf_g=jnp.zeros(L, jnp.float32).at[0].set(root[0]),
+        leaf_h=jnp.zeros(L, jnp.float32).at[0].set(root[1]),
+        leaf_c=jnp.zeros(L, jnp.float32).at[0].set(root[2]),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        leaf_min=jnp.full(L, -BIG, jnp.float32),
+        leaf_max=jnp.full(L, BIG, jnp.float32),
+        best=best,
+        tree=tree,
+    )
+    final = lax.while_loop(cond, body, state)
+
+    row_leaf = final.pleaf
+    if valid is not None:
+        row_leaf = jnp.where(valid > 0, row_leaf, -1)
+    return final.tree, row_leaf

@@ -37,18 +37,95 @@ def small_ds():
 
 def test_rounds_matches_greedy_unbound_budget(small_ds):
     """With a non-binding leaf budget, round-batched growth IS greedy:
-    both split exactly the positive-gain leaves."""
+    both split exactly the positive-gain leaves. Covers the legacy
+    permuted rounds prelude AND the natural-order rounds grower
+    (rounds.py)."""
     cfg = Config({"num_leaves": 512, "max_bin": 63, "min_data_in_leaf": 40,
                   "min_gain_to_split": 0.5})
     params = make_split_params(cfg)
     vals = {}
-    for rounds in (False, True):
+    variants = {
+        "seq": dict(),
+        "permuted_rounds": dict(rounds=True),
+        "nat_rounds": dict(rounds_slots=25),
+        "nat_rounds_small_k": dict(rounds_slots=4),
+    }
+    for name, kw in variants.items():
         spec = GrowerSpec(num_leaves=512, num_bins=small_ds.max_num_bin,
-                          max_depth=-1, rounds=rounds)
+                          max_depth=-1, **kw)
         tree, row_leaf = _grow(small_ds, params, spec)
         rl = np.asarray(row_leaf)[: small_ds.num_data]
-        vals[rounds] = np.asarray(tree.leaf_value)[rl]
-    np.testing.assert_allclose(vals[True], vals[False], atol=1e-5)
+        vals[name] = np.asarray(tree.leaf_value)[rl]
+    for name in variants:
+        np.testing.assert_allclose(vals[name], vals["seq"], atol=1e-5,
+                                   err_msg=name)
+
+
+def test_nat_rounds_tree_consistency(small_ds):
+    """Natural-order rounds with a BOUND budget: internally consistent
+    tree, full budget used, positive gains."""
+    cfg = Config({"num_leaves": 31, "max_bin": 63, "min_data_in_leaf": 5})
+    params = make_split_params(cfg)
+    spec = GrowerSpec(num_leaves=31, num_bins=small_ds.max_num_bin,
+                      max_depth=-1, rounds_slots=25)
+    tree, row_leaf = _grow(small_ds, params, spec)
+    nn = int(tree.num_nodes)
+    assert nn == 30
+    rl = np.asarray(row_leaf)[: small_ds.num_data]
+    lc = np.bincount(rl, minlength=31).astype(float)
+    np.testing.assert_allclose(lc, np.asarray(tree.leaf_count))
+    assert (np.asarray(tree.node_gain)[:nn] > 0).all()
+
+
+def test_nat_rounds_max_depth(small_ds):
+    cfg = Config({"num_leaves": 64, "max_bin": 63, "min_data_in_leaf": 5})
+    params = make_split_params(cfg)
+    spec = GrowerSpec(num_leaves=64, num_bins=small_ds.max_num_bin,
+                      max_depth=3, rounds_slots=25)
+    tree, _ = _grow(small_ds, params, spec)
+    assert int(tree.num_nodes) <= 7
+    assert int(np.max(np.asarray(tree.leaf_depth))) <= 3
+
+
+def test_growth_mode_via_train_api():
+    rs = np.random.RandomState(5)
+    X = rs.randn(3000, 6)
+    y = (X[:, 0] + X[:, 1] ** 2 + 0.3 * rs.randn(3000) > 1).astype(float)
+    from sklearn.metrics import roc_auc_score
+
+    preds = {}
+    for mode in ("exact", "rounds"):
+        params = dict(objective="binary", num_leaves=15, min_data_in_leaf=5,
+                      verbosity=-1, tpu_growth_mode=mode)
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst = lgb.train(params, ds, num_boost_round=5)
+        preds[mode] = bst.predict(X)
+        assert roc_auc_score(y, preds[mode]) > 0.85
+
+
+def test_hist_nat_slots_matches_bruteforce():
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.learner.histogram import build_gh8, hist_nat_slots
+
+    rs = np.random.RandomState(0)
+    N, F, B, S = 4096, 4, 31, 6
+    bins = jnp.asarray(rs.randint(0, B, (F, N)).astype(np.int32))
+    grad = rs.randn(N).astype(np.float32)
+    hess = (rs.rand(N) + 0.5).astype(np.float32)
+    gh8 = build_gh8(jnp.asarray(grad), jnp.asarray(hess),
+                    jnp.ones(N, jnp.float32))
+    slot = rs.randint(0, S + 1, N).astype(np.int32)  # S = trash slot
+    out = np.asarray(hist_nat_slots(bins, gh8, jnp.asarray(slot), S, B))
+    bn = np.asarray(bins)
+    gh3 = np.stack([grad, hess, np.ones(N, np.float32)])
+    for s in range(S):
+        m = slot == s
+        for f in range(F):
+            for c in range(3):
+                ref = np.bincount(bn[f][m], weights=gh3[c][m], minlength=B)[:B]
+                np.testing.assert_allclose(out[s, c, f], ref, atol=2e-4,
+                                           rtol=1e-4)
 
 
 def test_rounds_tree_consistency(small_ds):
