@@ -82,6 +82,7 @@ def find_groups(
     group_mask: List[np.ndarray] = []
     group_bins: List[int] = []
     group_conflict: List[int] = []
+    group_has_cat: List[bool] = []
     for f in order:
         f = int(f)
         width = int(num_bins[f]) - 1  # mfb slot excluded once merged
@@ -94,6 +95,14 @@ def find_groups(
             for gid in range(len(groups)):
                 if searched >= MAX_SEARCH_GROUP:
                     break
+                # a group founded by a categorical feature stays a
+                # dedicated column both ways: the categorical never
+                # merges INTO a group, and no numeric feature merges
+                # into ITS group (build_layout would offset-encode the
+                # categorical column, breaking the bin==category
+                # identity the sorted-subset scan relies on)
+                if group_has_cat[gid]:
+                    continue
                 if group_bins[gid] + width > max_group_bins:
                     continue
                 rest = budget - group_conflict[gid]
@@ -114,6 +123,7 @@ def find_groups(
             # a solo feature keeps its full bin range (incl. mfb)
             group_bins.append(1 + width)
             group_conflict.append(0)
+            group_has_cat.append(bool(is_cat[f]))
     return groups
 
 

@@ -185,6 +185,11 @@ def grow_tree_permuted(
     Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
     if spec.voting_k and spec.efb:
         raise ValueError("voting_k requires EFB off (feature==column)")
+    if spec.voting_k and spec.n_forced:
+        # forced splits read s.hist[fl] at the prescribed feature without
+        # a hist_valid gate; under voting non-elected columns hold stale
+        # per-shard values (ADVICE r3) — callers must disable one of them
+        raise ValueError("voting_k excludes forced splits (hist_valid)")
     per_node = spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups
     if spec.rounds and (per_node or spec.n_forced):
         raise ValueError("tpu_growth_rounds excludes per-node extras")

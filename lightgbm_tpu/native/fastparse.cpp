@@ -73,7 +73,11 @@ size_t line_end(const char* s, size_t n, size_t start) {
   return e;
 }
 
-double parse_field(const char* b, const char* e) {
+// `bad` (optional): set to true when the token is non-empty, not a
+// recognized missing-value token, and not fully numeric — callers use
+// it to fail the whole parse so the Python fallback (np.loadtxt, which
+// RAISES on such tokens) keeps native and fallback behavior aligned.
+double parse_field(const char* b, const char* e, bool* bad = nullptr) {
   while (b < e && std::isspace(static_cast<unsigned char>(*b))) ++b;
   while (e > b && std::isspace(static_cast<unsigned char>(e[-1]))) --e;
   if (b == e) return std::nan("");
@@ -92,7 +96,10 @@ double parse_field(const char* b, const char* e) {
   char* endp = nullptr;
   std::string tmp(b, e);  // strtod needs NUL termination
   double v = std::strtod(tmp.c_str(), &endp);
-  if (endp == tmp.c_str()) return std::nan("");
+  if (endp == tmp.c_str() || *endp != '\0') {
+    if (bad) *bad = true;
+    return std::nan("");
+  }
   return v;
 }
 
@@ -143,23 +150,35 @@ int fp_parse_delim(const char* path, char delim, int skip_rows,
   std::vector<int> errs(static_cast<size_t>(nt), 0);
   auto work = [&](int tid) {
     int64_t lo = n_rows * tid / nt, hi = n_rows * (tid + 1) / nt;
-    for (int64_t r = lo; r < hi; ++r) {
+    bool bad = false;
+    for (int64_t r = lo; r < hi && !bad; ++r) {
       size_t b = rows_[static_cast<size_t>(r)];
       size_t e = line_end(fb.data, fb.size, b);
       int64_t c = 0;
       size_t fs = b;
-      for (size_t i = b; i <= e && c < n_cols; ++i) {
+      for (size_t i = b; i <= e; ++i) {
         if (i == e || fb.data[i] == delim) {
-          mat[r * n_cols + c] = parse_field(fb.data + fs, fb.data + i);
+          if (c < n_cols)
+            mat[r * n_cols + c] = parse_field(fb.data + fs, fb.data + i, &bad);
           ++c;
           fs = i + 1;
         }
       }
-      for (; c < n_cols; ++c) mat[r * n_cols + c] = std::nan("");
+      // field-count mismatch = malformed file: fail the parse so the
+      // caller falls back to np.loadtxt, which raises (no silent
+      // NaN-padding / truncation on the native path only)
+      if (c != n_cols) bad = true;
     }
+    if (bad) errs[static_cast<size_t>(tid)] = 1;
   };
   for (int t = 0; t < nt; ++t) threads.emplace_back(work, t);
   for (auto& th : threads) th.join();
+  for (int err : errs) {
+    if (err) {
+      std::free(mat);
+      return 4;
+    }
+  }
 
   *out = mat;
   *out_rows = n_rows;
@@ -197,6 +216,14 @@ int fp_parse_libsvm(const char* path, double** out, double** out_label,
             while (j > b && std::isdigit(static_cast<unsigned char>(
                                 fb.data[j - 1])))
               --j;
+            // index part must be non-empty, all digits from the token
+            // start (skip qid:/cost: style tokens — strtoll("qid")
+            // would otherwise alias them onto feature 0, diverging
+            // from the numpy fallback which raises on int("qid"))
+            if (j == i) continue;
+            if (j > b && !std::isspace(static_cast<unsigned char>(
+                             fb.data[j - 1])))
+              continue;
             int64_t idx = std::strtoll(std::string(fb.data + j, fb.data + i).c_str(),
                                        nullptr, 10);
             if (idx > mx) mx = idx;
@@ -241,6 +268,16 @@ int fp_parse_libsvm(const char* path, double** out, double** out_label,
                !std::isspace(static_cast<unsigned char>(fb.data[i])))
           ++i;
         if (i >= e || fb.data[i] != ':') continue;
+        bool all_digits = i > fs;
+        for (size_t k = fs; k < i && all_digits; ++k)
+          if (!std::isdigit(static_cast<unsigned char>(fb.data[k])))
+            all_digits = false;
+        if (!all_digits) {
+          // qid:/cost: style token — skip it (value included) entirely
+          while (i < e && !std::isspace(static_cast<unsigned char>(fb.data[i])))
+            ++i;
+          continue;
+        }
         int64_t idx = std::strtoll(
             std::string(fb.data + fs, fb.data + i).c_str(), nullptr, 10);
         ++i;

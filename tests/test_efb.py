@@ -118,3 +118,20 @@ def test_efb_valid_set_and_model_io(tmp_path):
     np.testing.assert_allclose(
         bst.predict(Xv), bst2.predict(Xv), rtol=1e-6, atol=1e-7
     )
+
+
+def test_find_groups_cat_founded_group_stays_dedicated():
+    """A sparse NUMERIC feature must not merge into a group founded by a
+    categorical feature (ADVICE r3): build_layout would offset-encode
+    the categorical column, breaking bin==category identity."""
+    n = 10000
+    rs = np.random.RandomState(1)
+    owner = rs.randint(0, 2, n)
+    bins = np.zeros((2, n), dtype=np.int32)
+    # feature 0: sparse categorical; feature 1: sparse numeric, exclusive
+    bins[0, owner == 0] = rs.randint(1, 6, int((owner == 0).sum()))
+    bins[1, owner == 1] = rs.randint(1, 6, int((owner == 1).sum()))
+    groups = find_groups(bins, [6, 6], [0, 0], [True, False], 256)
+    for g in groups:
+        if 0 in g:
+            assert g == [0], f"categorical group was merged into: {g}"

@@ -68,3 +68,50 @@ def test_cli_data_path_uses_native(tmp_path, lib_ok):
     out = load_text_file(str(p))
     assert out["X"].shape == (300, 4)
     np.testing.assert_allclose(out["label"], y)
+
+
+def test_parse_libsvm_skips_qid_tokens(tmp_path, lib_ok):
+    """`qid:3` must not alias onto feature 0 (ADVICE r3): the index part
+    of a token must be all digits in both the max-index scan and the
+    fill pass."""
+    p = tmp_path / "rank.svm"
+    with open(p, "w") as f:
+        f.write("2 qid:1 0:0.5 2:1.5\n")
+        f.write("1 qid:1 1:-2.0\n")
+        f.write("0 qid:2 0:7.0\n")
+    labels, X = native.parse_libsvm(str(p))
+    np.testing.assert_allclose(labels, [2, 1, 0])
+    expect = np.zeros((3, 3))
+    expect[0, 0] = 0.5
+    expect[0, 2] = 1.5
+    expect[1, 1] = -2.0
+    expect[2, 0] = 7.0
+    np.testing.assert_allclose(X, expect)  # qid values NOT in column 0
+
+
+def test_parse_delim_rejects_malformed(tmp_path, lib_ok):
+    """Unparseable tokens / ragged rows fail the native parse (rc != 0
+    -> None) instead of silently training on NaN-filled data; the
+    np.loadtxt fallback raises on the same files (ADVICE r3)."""
+    bad_token = tmp_path / "tok.csv"
+    with open(bad_token, "w") as f:
+        f.write("1.0,2.0,3.0\n")
+        f.write("4.0,oops,6.0\n")
+    assert native.parse_delim(str(bad_token), ",", 0) is None
+
+    ragged = tmp_path / "ragged.csv"
+    with open(ragged, "w") as f:
+        f.write("1.0,2.0,3.0\n")
+        f.write("4.0,5.0\n")
+        f.write("4.0,5.0,6.0,7.0\n")
+    assert native.parse_delim(str(ragged), ",", 0) is None
+
+    # NA tokens and empty fields remain fine (explicitly supported)
+    ok = tmp_path / "ok.csv"
+    with open(ok, "w") as f:
+        f.write("1.0,NA,3.0\n")
+        f.write("4.0,,nan\n")
+    out = native.parse_delim(str(ok), ",", 0)
+    np.testing.assert_allclose(
+        out, [[1.0, np.nan, 3.0], [4.0, np.nan, np.nan]], equal_nan=True
+    )

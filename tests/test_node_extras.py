@@ -148,3 +148,19 @@ def test_forced_splits_invalid_file_warns(tmp_path, capsys):
     b = _train({**BASE, "forcedsplits_filename": str(p), "verbosity": 0},
                X, y, rounds=1)
     assert b.num_trees() == 1  # training proceeds without forcing
+
+
+def test_forced_splits_with_feature_learner(tmp_path):
+    """tree_learner=feature + forcedsplits must not crash (ADVICE r3):
+    the plan is dropped with a warning, training proceeds."""
+    import json
+
+    X, y = _problem(f=4, seed=6)
+    plan = {"feature": 2, "threshold": 0.0}
+    p = tmp_path / "forced.json"
+    p.write_text(json.dumps(plan))
+    b = _train(
+        {**BASE, "forcedsplits_filename": str(p),
+         "tree_learner": "feature"}, X, y, rounds=2,
+    )
+    assert b.num_trees() == 2
