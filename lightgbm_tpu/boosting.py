@@ -401,9 +401,15 @@ class GBDT:
             rounds=(config.tpu_growth_rounds and not use_rounds
                     and rounds_ok),
             rounds_slots=(
-                min(config.tpu_round_slots, config.num_leaves)
+                min(config.tpu_round_slots
+                    or (42 if config.use_quantized_grad else 25),
+                    config.num_leaves)
                 if use_rounds else 0
             ),
+            # int levels must be bf16-exact (integers <= 256); larger
+            # num_grad_quant_bins rides the dequantized 5-channel path
+            quant=bool(use_rounds and config.use_quantized_grad
+                       and config.num_grad_quant_bins <= 256),
             voting_k=config.top_k if use_voting else 0,
             extra_trees=use_extra,
             ff_bynode=use_bynode,
@@ -469,17 +475,18 @@ class GBDT:
         return alpha, w
 
     def _quantize(self, gk, hk, it, k):
-        """use_quantized_grad: discretize this tree's gradients
-        (gradient_discretizer.cpp DiscretizeGradients); traceable."""
+        """use_quantized_grad: discretize this tree's gradients to
+        INTEGER levels + scales (gradient_discretizer.cpp
+        DiscretizeGradients); traceable."""
         import jax
 
-        from .learner.quantize import discretize_gradients
+        from .learner.quantize import discretize_gradients_int
 
         c = self.config
         key = jax.random.fold_in(
             jax.random.key(c.data_random_seed), it * self.num_class + k
         )
-        return discretize_gradients(
+        return discretize_gradients_int(
             gk, hk, key, c.num_grad_quant_bins, c.stochastic_rounding
         )
 
@@ -491,18 +498,26 @@ class GBDT:
         c = self.config
         if not c.use_quantized_grad:
             return self._grow(gk, hk, mask, feat_mask, valid, it, k)
-        gq, hq = self._quantize(gk, hk, it, k)
-        arrays, row_leaf = self._grow(gq, hq, mask, feat_mask, valid, it, k)
-        if c.quant_train_renew_leaf:
-            if self._quant_renew_ok:
-                from .learner.quantize import renew_leaf_with_true_gradients
+        gq, hq, scale = self._quantize(gk, hk, it, k)
+        if self.spec.quant:
+            # rounds grower consumes the integer levels directly: exact
+            # int histogram sums in 3 channels/slot (42 slots/MXU pass)
+            arrays, row_leaf = self._grow(
+                gq, hq, mask, feat_mask, valid, it, k, gh_scale=scale
+            )
+        else:
+            arrays, row_leaf = self._grow(
+                gq * scale[0], hq * scale[1], mask, feat_mask, valid, it, k
+            )
+        if c.quant_train_renew_leaf and self._quant_renew_ok:
+            from .learner.quantize import renew_leaf_with_true_gradients
 
-                arrays = arrays._replace(
-                    leaf_value=renew_leaf_with_true_gradients(
-                        arrays.leaf_value, row_leaf, gk, hk, mask,
-                        self.params, self.spec.num_leaves,
-                    )
+            arrays = arrays._replace(
+                leaf_value=renew_leaf_with_true_gradients(
+                    arrays.leaf_value, row_leaf, gk, hk, mask,
+                    self.params, self.spec.num_leaves,
                 )
+            )
         return arrays, row_leaf
 
     def _apply_renewal(self, arrays, row_leaf, score_k, mask, renew_alpha,
@@ -519,7 +534,7 @@ class GBDT:
         )
 
     # ------------------------------------------------------------------
-    def _grow(self, gk, hk, mask, feat_mask, valid, it=0, k=0):
+    def _grow(self, gk, hk, mask, feat_mask, valid, it=0, k=0, gh_scale=None):
         """Grow one tree on the training set — serial, or sharded over the
         data mesh when tree_learner=data/voting (lockstep trees on every
         shard, reference data_parallel_tree_learner.cpp). Traceable: used
@@ -537,14 +552,14 @@ class GBDT:
                 d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
                 gk, hk, mask, feat_mask, self.params, valid,
                 d.get("bundle"), rng_key, self._group_mat, self._cegb_info,
-                self._forced,
+                self._forced, gh_scale,
             )
         return grow_tree(
             d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
             gk, hk, mask, feat_mask, self.params, self.spec, valid=valid,
             bundle=d.get("bundle"), rng_key=rng_key,
             group_mat=self._group_mat, cegb=self._cegb_info,
-            forced=self._forced,
+            forced=self._forced, gh_scale=gh_scale,
         )
 
     # ------------------------------------------------------------------

@@ -194,9 +194,10 @@ _PARAMS: Dict[str, _P] = {
     # otherwise — so CPU test/parity runs keep reference-exact trees.
     "tpu_growth_mode": ("auto", str, (),
                         lambda v: v in ("auto", "rounds", "exact")),
-    # max leaves split per round in rounds mode; 25 packs
-    # 25 x 5 gh channels onto the MXU's 128-row matmul axis
-    "tpu_round_slots": (25, int, (), _pos),
+    # max leaves split per round in rounds mode; 0 = auto (25 = 5 gh
+    # channels x 25 slots filling the MXU's 128-row matmul axis; 42
+    # under use_quantized_grad's 3 integer channels)
+    "tpu_round_slots": (0, int, (), _nonneg),
     "tpu_hist_dtype": ("float32", str, (), None),
     "tpu_mesh_axes": ("data", str, (), None),
 }

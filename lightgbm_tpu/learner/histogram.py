@@ -155,12 +155,15 @@ def hist_slots(
 
 def _hist_nat_fallback(bins_fm: jax.Array, gh8: jax.Array, slot: jax.Array,
                        num_slots: int, num_bins: int,
-                       blk: int = 512) -> jax.Array:
+                       blk: int = 512, quant: bool = False) -> jax.Array:
     """XLA reference for hist_nat_slots: blocked one-hot einsum with an
     extra slot one-hot axis. Any N; CPU tests and odd row counts."""
     F, N = bins_fm.shape
     S = num_slots
-    gh3 = jnp.stack([gh8[0] + gh8[1], gh8[2] + gh8[3], gh8[4]])  # (3, N)
+    if quant:
+        gh3 = gh8[:3]  # (g_int, h_int, count) — no hi/lo split
+    else:
+        gh3 = jnp.stack([gh8[0] + gh8[1], gh8[2] + gh8[3], gh8[4]])  # (3, N)
     if N % blk != 0:
         pad = blk - N % blk
         bins_fm = jnp.pad(bins_fm, ((0, 0), (0, pad)))
@@ -189,12 +192,23 @@ def _hist_nat_fallback(bins_fm: jax.Array, gh8: jax.Array, slot: jax.Array,
     return out
 
 
+def build_gh8_quant(gq: jax.Array, hq: jax.Array, count: jax.Array) -> jax.Array:
+    """Quantized-channel layout: (g_int, h_int, count, 0, ...). Integer
+    levels (|g| <= num_grad_quant_bins/2 etc.) are exact in bf16, so the
+    hi/lo split is unnecessary — 3 channels per slot instead of 5 packs
+    42 slots per MXU pass (the TPU analog of the reference's int16
+    histogram entries, bin.h:63-81)."""
+    z = jnp.zeros_like(count)
+    return jnp.stack([gq, hq, count, z, z, z, z, z])
+
+
 def hist_nat_slots(
     bins_fm: jax.Array,  # (F, N) int32, NATURAL row order
     gh8: jax.Array,  # (8, N) f32 build_gh8 channels
     slot: jax.Array,  # (N,) int32 in [0, num_slots]; num_slots = trash
     num_slots: int,
     num_bins: int,
+    quant: bool = False,  # gh8 built by build_gh8_quant (3 channels)
 ) -> jax.Array:
     """Per-slot histograms keyed by a row->slot vector -> (S, 3, F, B).
 
@@ -208,11 +222,12 @@ def hist_nat_slots(
     the reference CUDA kernel (cuda_histogram_constructor.cu:20) without
     its per-leaf row indices."""
     F, N = bins_fm.shape
+    nat_ch = 3 if quant else NAT_CH
     # VMEM guard: the kernel holds out + scratch accumulators of
-    # (chunk*NAT_CH, F*B) f32 each; chunk the slot axis so both fit the
+    # (chunk*nat_ch, F*B) f32 each; chunk the slot axis so both fit the
     # ~16MB/core budget (wide feature sets would otherwise fail the
     # Mosaic compile on the default-on TPU path)
-    per_slot = NAT_CH * F * num_bins * 4 * 2
+    per_slot = nat_ch * F * num_bins * 4 * 2
     s_max = max(1, (12 * 2 ** 20) // max(per_slot, 1))
     if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
             and per_slot <= 12 * 2 ** 20):
@@ -228,14 +243,18 @@ def hist_nat_slots(
                 local = jnp.where(in_chunk, slot - c0, sc)
             out = hist_nat_tpu(
                 bins_fm, gh8, local, sc, num_bins,
-                interpret=_interpret_pallas(),
-            )  # (sc*NAT_CH, F*B)
-            o = out.reshape(sc, NAT_CH, F, num_bins)
-            parts.append(jnp.stack(
-                [o[:, 0] + o[:, 1], o[:, 2] + o[:, 3], o[:, 4]], axis=1
-            ))
+                interpret=_interpret_pallas(), nat_ch=nat_ch,
+            )  # (sc*nat_ch, F*B)
+            o = out.reshape(sc, nat_ch, F, num_bins)
+            if quant:
+                parts.append(o)
+            else:
+                parts.append(jnp.stack(
+                    [o[:, 0] + o[:, 1], o[:, 2] + o[:, 3], o[:, 4]], axis=1
+                ))
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-    return _hist_nat_fallback(bins_fm, gh8, slot, num_slots, num_bins)
+    return _hist_nat_fallback(bins_fm, gh8, slot, num_slots, num_bins,
+                              quant=quant)
 
 
 def gather_rows(bins_fm: jax.Array, idx: jax.Array) -> jax.Array:

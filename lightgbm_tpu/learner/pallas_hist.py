@@ -38,13 +38,14 @@ from .histogram import CH, HIST_BLK, NAT_CH
 
 
 def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref, acc_ref,
-                *, F: int, B: int, blk: int, S: int):
+                *, F: int, B: int, blk: int, S: int, nat_ch: int):
     """Slot-packed natural-order histogram: rows carry a slot id; the
     weight matrix W packs (slot x channel) onto the MXU's M axis —
-    W[(s, c), r] = gh[c, r] * (slot[r] == s) — so one (S*NAT_CH, blk) @
+    W[(s, c), r] = gh[c, r] * (slot[r] == s) — so one (S*nat_ch, blk) @
     (blk, B) matmul per feature accumulates ALL slots' histograms. With
-    S*NAT_CH ~ 125 of the MXU's 128 M rows useful, up to 25 slots cost
-    the wall time the single-leaf kernel spends on 8 rows."""
+    S*nat_ch ~ 125 of the MXU's 128 M rows useful, up to 25 slots (42
+    under quantized training's 3 integer channels) cost the wall time
+    the single-leaf kernel spends on 8 rows."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -52,11 +53,11 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref, acc_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     slot = slot_ref[0, :]  # (blk,) int32
-    gh = gh_ref[...]  # (CH, blk) f32; rows 0..NAT_CH-1 are live
+    gh = gh_ref[...]  # (CH, blk) f32; rows 0..nat_ch-1 are live
     iota_s = lax.broadcasted_iota(jnp.int32, (S, blk), 0)
     sl = (slot[None, :] == iota_s).astype(jnp.bfloat16)  # (S, blk)
-    g5 = gh[:NAT_CH, :].astype(jnp.bfloat16)  # (NAT_CH, blk)
-    W = (sl[:, None, :] * g5[None, :, :]).reshape(S * NAT_CH, blk)
+    g5 = gh[:nat_ch, :].astype(jnp.bfloat16)  # (nat_ch, blk)
+    W = (sl[:, None, :] * g5[None, :, :]).reshape(S * nat_ch, blk)
 
     bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
     iota_b = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
@@ -72,7 +73,8 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref, acc_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_slots", "num_bins", "blk", "interpret")
+    jax.jit,
+    static_argnames=("num_slots", "num_bins", "blk", "interpret", "nat_ch"),
 )
 def hist_nat_tpu(
     bins_fm: jax.Array,  # (F, N) int32, natural row order
@@ -82,8 +84,9 @@ def hist_nat_tpu(
     num_bins: int,
     blk: int = HIST_BLK,
     interpret: bool = False,
+    nat_ch: int = NAT_CH,
 ) -> jax.Array:
-    """(S*NAT_CH, F*B) f32 packed per-slot channel histograms."""
+    """(S*nat_ch, F*B) f32 packed per-slot channel histograms."""
     F, N = bins_fm.shape
     assert N % blk == 0, (N, blk)
     assert gh8.shape == (CH, N), gh8.shape
@@ -91,7 +94,7 @@ def hist_nat_tpu(
     S = num_slots
     nb = N // blk
     out = pl.pallas_call(
-        functools.partial(_nat_kernel, F=F, B=B, blk=blk, S=S),
+        functools.partial(_nat_kernel, F=F, B=B, blk=blk, S=S, nat_ch=nat_ch),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((F, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
@@ -99,10 +102,10 @@ def hist_nat_tpu(
             pl.BlockSpec((1, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (S * NAT_CH, F * B), lambda i: (0, 0), memory_space=pltpu.VMEM
+            (S * nat_ch, F * B), lambda i: (0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((S * NAT_CH, F * B), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((S * NAT_CH, F * B), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((S * nat_ch, F * B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((S * nat_ch, F * B), jnp.float32)],
         interpret=interpret,
     )(bins_fm, gh8, slot.reshape(1, N))
     return out

@@ -23,20 +23,20 @@ pre-generated random value table with a rotating start offset
 from __future__ import annotations
 
 
-def discretize_gradients(
+def discretize_gradients_int(
     grad,
     hess,
     key,
     num_bins: int,
     stochastic: bool,
 ):
-    """(grad, hess) -> dequantized (grad_q, hess_q) at num_bins levels.
+    """(grad, hess) -> ((grad_q, hess_q) INTEGER levels, (2,) scales).
 
     Matches DiscretizeGradients: grad levels in [-bins/2, bins/2],
     hess levels in [0, bins]; stochastic rounding truncates toward zero
     after adding signed uniform noise, plain rounding truncates after
-    adding 0.5.
-    """
+    adding 0.5. The integer levels feed the rounds grower's 3-channel
+    exact-int histogram path (spec.quant)."""
     import jax
     import jax.numpy as jnp
 
@@ -51,7 +51,22 @@ def discretize_gradients(
         uh = 0.5
     gq = jnp.trunc(grad / g_scale + jnp.sign(grad) * ug)
     hq = jnp.trunc(hess / h_scale + uh)  # hessians are non-negative
-    return gq * g_scale, hq * h_scale
+    return gq, hq, jnp.stack([g_scale, h_scale])
+
+
+def discretize_gradients(
+    grad,
+    hess,
+    key,
+    num_bins: int,
+    stochastic: bool,
+):
+    """(grad, hess) -> dequantized (grad_q, hess_q) at num_bins levels
+    (level * scale), for the growers that consume plain f32 channels."""
+    gq, hq, scale = discretize_gradients_int(
+        grad, hess, key, num_bins, stochastic
+    )
+    return gq * scale[0], hq * scale[1]
 
 
 def renew_leaf_with_true_gradients(leaf_value, row_leaf, grad, hess, mask,

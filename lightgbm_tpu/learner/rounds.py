@@ -41,7 +41,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from .bundle import BundleInfo, decode_feature_bins, expand_hist
-from .histogram import build_gh8, hist_nat_slots, histogram, root_sums
+from .histogram import (
+    build_gh8,
+    build_gh8_quant,
+    hist_nat_slots,
+    histogram,
+    root_sums,
+)
 from .grower import (
     GrowerSpec,
     TreeArrays,
@@ -82,8 +88,16 @@ def grow_tree_rounds(
     spec: GrowerSpec,
     valid: Optional[jax.Array] = None,
     bundle: Optional[BundleInfo] = None,
+    gh_scale: Optional[jax.Array] = None,  # (2,) [g_scale, h_scale]
 ) -> Tuple[TreeArrays, jax.Array]:
-    """Grow one tree; returns (tree arrays, natural-order row->leaf)."""
+    """Grow one tree; returns (tree arrays, natural-order row->leaf).
+
+    With spec.quant, grad/hess are INTEGER quantization levels and
+    gh_scale carries the per-iteration dequantization scales: histogram
+    sums stay exact integers (bf16 products, f32 accumulation) and are
+    multiplied by the scales once per histogram before split search —
+    the reference's int-histogram arithmetic (gradient_discretizer.cpp,
+    feature_histogram.hpp:1062) mapped onto the MXU."""
     L = spec.num_leaves
     B = spec.num_bins
     G, N = bins_fm.shape  # G = device columns (bundles when spec.efb)
@@ -98,17 +112,37 @@ def grow_tree_rounds(
         raise ValueError(
             "per-node extras / forced splits ride the permuted grower"
         )
+    if spec.quant and gh_scale is None:
+        raise ValueError("spec.quant requires gh_scale (level scales)")
 
     def exp_hist(h, g_sum, h_sum, c_sum):
         if spec.efb:
             return expand_hist(h, g_sum, h_sum, c_sum, bundle)
         return h
 
-    gh8 = build_gh8(grad * mask, hess * mask, mask)  # (8, N)
-    root = root_sums(gh8, ax)
-    hist0 = histogram(bins_fm, gh8, Bc)
-    if ax is not None:
-        hist0 = lax.psum(hist0, ax)
+    if spec.quant:
+        gh8 = build_gh8_quant(grad * mask, hess * mask, mask)  # (8, N)
+        scale3 = jnp.stack(
+            [gh_scale[0], gh_scale[1], jnp.float32(1.0)]
+        )  # (3,)
+        s8 = jnp.sum(gh8, axis=1)
+        root = jnp.stack([s8[0], s8[1], s8[2]])
+        if ax is not None:
+            root = lax.psum(root, ax)
+        root = root * scale3
+        hist0 = hist_nat_slots(
+            bins_fm, gh8, jnp.zeros(N, jnp.int32), 1, Bc, quant=True
+        )[0]
+        if ax is not None:
+            hist0 = lax.psum(hist0, ax)
+        hist0 = hist0 * scale3[:, None, None]
+    else:
+        scale3 = None
+        gh8 = build_gh8(grad * mask, hess * mask, mask)  # (8, N)
+        root = root_sums(gh8, ax)
+        hist0 = histogram(bins_fm, gh8, Bc)
+        if ax is not None:
+            hist0 = lax.psum(hist0, ax)
     root_out = leaf_output(root[0], root[1], params)
     rec0 = best_split(exp_hist(hist0, root[0], root[1], root[2]),
                       root[0], root[1], root[2], num_bins, nan_bin,
@@ -243,9 +277,13 @@ def grow_tree_rounds(
         left_smaller = rec.left_c <= rec.right_c  # (L,)
         go_small = go_left == left_smaller[pl_c]
         hslot = jnp.where(in_split & go_small, rank[pl_c], S).astype(jnp.int32)
-        slot_hists = hist_nat_slots(bins_fm, gh8, hslot, S, Bc)  # (S,3,G,Bc)
+        slot_hists = hist_nat_slots(
+            bins_fm, gh8, hslot, S, Bc, quant=spec.quant
+        )  # (S, 3, G, Bc)
         if ax is not None:
             slot_hists = lax.psum(slot_hists, ax)
+        if spec.quant:
+            slot_hists = slot_hists * scale3[:, None, None]
 
         # ---- per-slot child hists: smaller from the pass, larger by
         # subtraction; scatter both into the pool. Work stays O(S), not

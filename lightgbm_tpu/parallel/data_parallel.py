@@ -56,12 +56,12 @@ class DataParallelGrower:
 
         def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
                feat_mask, params, valid, bundle, rng_key, group_mat, cegb,
-               forced):
+               forced, gh_scale):
             tree, row_leaf = grow_tree(
                 bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
                 feat_mask, params, self.spec, valid=valid, bundle=bundle,
                 rng_key=rng_key, group_mat=group_mat, cegb=cegb,
-                forced=forced,
+                forced=forced, gh_scale=gh_scale,
             )
             # tree state is identical on all shards (computed from psum'd
             # histograms); mark it replicated for the out_spec
@@ -69,7 +69,7 @@ class DataParallelGrower:
             return tree, row_leaf
 
         in_specs = (bins_spec, rep, rep, rep, rep, row, row, row, rep, rep,
-                    row, rep, rep, rep, rep, rep)
+                    row, rep, rep, rep, rep, rep, rep)
         out_specs = (jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)), row)
         self._fn = jax.jit(
             jax.shard_map(
@@ -84,10 +84,10 @@ class DataParallelGrower:
     def __call__(self, bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
                  feat_mask, params: SplitParams, valid, bundle=None,
                  rng_key=None, group_mat=None, cegb=None, forced=None,
-                 ) -> Tuple[TreeArrays, jax.Array]:
+                 gh_scale=None) -> Tuple[TreeArrays, jax.Array]:
         return self._fn(
             bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask,
-            params, valid, bundle, rng_key, group_mat, cegb, forced,
+            params, valid, bundle, rng_key, group_mat, cegb, forced, gh_scale,
         )
 
     def shard_inputs(self, dev: dict) -> dict:

@@ -116,8 +116,9 @@ class FeatureParallelGrower:
     def __call__(self, bins, nan_bin, num_bins, mono, is_cat, grad, hess,
                  mask, feat_mask, params: SplitParams, valid, bundle=None,
                  rng_key=None, group_mat=None, cegb=None, forced=None,
-                 ) -> Tuple[TreeArrays, jax.Array]:
+                 gh_scale=None) -> Tuple[TreeArrays, jax.Array]:
         del bundle, rng_key, group_mat, cegb, forced  # unsupported (warned)
+        del gh_scale  # quantized rounds mode never routes here
         fp = bins.shape[0]
         pad = fp - feat_mask.shape[0]
         if pad:

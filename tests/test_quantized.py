@@ -107,3 +107,57 @@ def test_quantized_regression_l2():
     )
     mse = float(np.mean((q.predict(X) - y) ** 2))
     assert mse < 0.3 * float(np.var(y)), mse
+
+
+def test_quantized_rounds_matches_dequantized_semantics():
+    """The rounds grower's exact-int histogram path (spec.quant) must
+    produce the same trees as feeding the DEQUANTIZED values through the
+    standard channels: int sums x scale == sums of (level x scale)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import BinnedDataset
+    from lightgbm_tpu.learner import GrowerSpec, grow_tree, make_split_params
+    from lightgbm_tpu.learner.quantize import discretize_gradients_int
+
+    rs = np.random.RandomState(3)
+    X = rs.randn(4096, 6).astype(np.float32)
+    cfg = Config({"max_bin": 63, "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_numpy(X, cfg)
+    d = ds.device_arrays()
+    N = ds.num_rows_padded()
+    F = ds.num_used_features
+    g = jnp.asarray(rs.randn(N).astype(np.float32)) * d["valid"]
+    h = (jnp.ones(N, jnp.float32) * 0.25) * d["valid"]
+    gq, hq, scale = discretize_gradients_int(g, h, jax.random.key(1), 4, False)
+    params = make_split_params(Config({"num_leaves": 31, "max_bin": 63,
+                                       "min_data_in_leaf": 5}))
+    base = dict(num_leaves=31, num_bins=ds.max_num_bin, max_depth=-1)
+    spec_q = GrowerSpec(**base, rounds_slots=25, quant=True)
+    spec_f = GrowerSpec(**base, rounds_slots=25)
+    tq, rlq = grow_tree(
+        d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+        gq, hq, d["valid"], jnp.ones(F, bool), params, spec_q,
+        valid=d["valid"], gh_scale=scale,
+    )
+    tf, rlf = grow_tree(
+        d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+        gq * scale[0], hq * scale[1], d["valid"], jnp.ones(F, bool), params,
+        spec_f, valid=d["valid"],
+    )
+    assert int(tq.num_nodes) == int(tf.num_nodes)
+    np.testing.assert_array_equal(np.asarray(rlq), np.asarray(rlf))
+    np.testing.assert_allclose(np.asarray(tq.leaf_value),
+                               np.asarray(tf.leaf_value), atol=1e-5)
+
+
+def test_quantized_rounds_via_train_api():
+    rs = np.random.RandomState(6)
+    X = rs.randn(3000, 6)
+    y = (X[:, 0] + X[:, 1] ** 2 + 0.3 * rs.randn(3000) > 1).astype(float)
+    from sklearn.metrics import roc_auc_score
+
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=5,
+                  verbosity=-1, use_quantized_grad=True,
+                  tpu_growth_mode="rounds")
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=8)
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
