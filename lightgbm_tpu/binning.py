@@ -38,6 +38,11 @@ class BinType(enum.IntEnum):
     CATEGORICAL = 1
 
 
+def _check_double_equal_ordered(a: float, b: float) -> bool:
+    """Common::CheckDoubleEqualOrdered (common.h:851): b <= nextafter(a)."""
+    return b <= np.nextafter(a, np.inf)
+
+
 def greedy_find_bin(
     distinct_values: np.ndarray,
     counts: np.ndarray,
@@ -47,13 +52,16 @@ def greedy_find_bin(
 ) -> List[float]:
     """Build <=max_bin upper bounds over sorted distinct values.
 
-    Mirrors src/io/bin.cpp GreedyFindBin: small-cardinality features get one
-    bin per distinct value (merging ones below min_data_in_bin); otherwise a
-    greedy equal-mass packing where any value holding >= mean bin mass gets
-    its own bin.
+    Bit-exact mirror of src/io/bin.cpp:80 GreedyFindBin (verified by the
+    first-tree structure parity test against the built reference CLI):
+    small-cardinality features get one bin per distinct value (merging
+    ones below min_data_in_bin); otherwise a greedy equal-mass packing
+    where any value holding >= mean bin mass gets its own bin. Bounds
+    are nextafter-nudged midpoints (Common::GetDoubleUpperBound) with
+    ordered-equality dedup.
     """
     num_distinct = len(distinct_values)
-    upper_bounds: List[float] = []
+    bub: List[float] = []
     if num_distinct == 0:
         return [float("inf")]
     if num_distinct <= max_bin:
@@ -61,45 +69,63 @@ def greedy_find_bin(
         for i in range(num_distinct - 1):
             cur_cnt_inbin += int(counts[i])
             if cur_cnt_inbin >= min_data_in_bin:
-                upper_bounds.append(
-                    (float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0
-                )
-                cur_cnt_inbin = 0
-        upper_bounds.append(float("inf"))
-        return upper_bounds
+                val = float(np.nextafter(
+                    (float(distinct_values[i]) + float(distinct_values[i + 1]))
+                    / 2.0, np.inf,
+                ))
+                if not bub or not _check_double_equal_ordered(bub[-1], val):
+                    bub.append(val)
+                    cur_cnt_inbin = 0
+        bub.append(float("inf"))
+        return bub
 
-    max_bin = min(max_bin, max(1, total_cnt // min_data_in_bin))
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
     mean_bin_size = total_cnt / max_bin
     is_big = counts >= mean_bin_size
     rest_bin_cnt = max_bin - int(np.sum(is_big))
     rest_sample_cnt = total_cnt - int(np.sum(counts[is_big]))
-    if rest_bin_cnt > 0:
-        mean_bin_size = rest_sample_cnt / rest_bin_cnt
+    mean_bin_size = (
+        rest_sample_cnt / rest_bin_cnt if rest_bin_cnt > 0 else float("inf")
+    )
+    uppers = [float("inf")] * max_bin
+    lowers = [float("inf")] * max_bin
     bin_cnt = 0
-    lower_bounds_open = True
+    lowers[0] = float(distinct_values[0])
     cur_cnt_inbin = 0
     for i in range(num_distinct - 1):
         if not is_big[i]:
             rest_sample_cnt -= int(counts[i])
         cur_cnt_inbin += int(counts[i])
         # need a new bin: current value is big, accumulated enough mass, or
-        # next value is big and we have at least min_data_in_bin
+        # next value is big and we have at least half a mean bin
         if (
             is_big[i]
             or cur_cnt_inbin >= mean_bin_size
-            or (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))
+            or (is_big[i + 1]
+                and cur_cnt_inbin >= max(1.0, mean_bin_size * np.float32(0.5)))
         ):
-            upper_bounds.append(
-                (float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0
-            )
+            uppers[bin_cnt] = float(distinct_values[i])
             bin_cnt += 1
-            cur_cnt_inbin = 0
+            lowers[bin_cnt] = float(distinct_values[i + 1])
             if bin_cnt >= max_bin - 1:
                 break
-            if not is_big[i] and rest_bin_cnt > bin_cnt:
-                mean_bin_size = rest_sample_cnt / (rest_bin_cnt - bin_cnt)
-    upper_bounds.append(float("inf"))
-    return upper_bounds
+            cur_cnt_inbin = 0
+            # only bins closed on NON-big values consume the rest budget
+            # (big values pre-paid theirs in the scan above)
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = (
+                    rest_sample_cnt / rest_bin_cnt if rest_bin_cnt > 0
+                    else float("inf")
+                )
+    bin_cnt += 1
+    for i in range(bin_cnt - 1):
+        val = float(np.nextafter((uppers[i] + lowers[i + 1]) / 2.0, np.inf))
+        if not bub or not _check_double_equal_ordered(bub[-1], val):
+            bub.append(val)
+    bub.append(float("inf"))
+    return bub
 
 
 def find_bin_bounds(
@@ -127,35 +153,38 @@ def find_bin_bounds(
         dv, cnt = np.unique(values, return_counts=True)
         return greedy_find_bin(dv, cnt, max_bin, total_sample_cnt, min_data_in_bin)
 
-    bounds: List[float] = []
-    # budget split proportional to counts on each side (reference :165-186)
-    left_cnt = len(neg)
-    right_cnt = len(pos)
-    non_zero = left_cnt + right_cnt
-    if non_zero == 0:
+    # FindBinWithZeroAsOneBin (bin.cpp:246), kept branch-for-branch:
+    # the zero bin exists whenever a positive side exists (kZeroThreshold
+    # bound pushed unconditionally before the right-side bounds), and the
+    # left budget is left_cnt_data / (total - zeros) * (max_bin - 1)
+    left_cnt_data = len(neg)
+    right_cnt_data = len(pos)
+    if left_cnt_data + right_cnt_data + zero_cnt == 0:
         return [float("inf")]
-    left_max_bin = max(1, int((max_bin - 1) * left_cnt / max(1, non_zero + zero_cnt)))
-    if left_cnt > 0:
-        dv, cnt = np.unique(neg, return_counts=True)
-        bounds.extend(greedy_find_bin(dv, cnt, left_max_bin, left_cnt, min_data_in_bin))
-        # the last bound of the negative side closes at -kZeroThreshold
-        bounds[-1] = -K_ZERO_THRESHOLD
-    if zero_cnt > 0 or (left_cnt > 0 and right_cnt > 0):
-        bounds.append(K_ZERO_THRESHOLD)  # the zero bin
-    if right_cnt > 0:
-        right_max_bin = max_bin - 1 - len(bounds)
-        right_max_bin = max(1, right_max_bin)
-        dv, cnt = np.unique(pos, return_counts=True)
-        bounds.extend(
-            greedy_find_bin(dv, cnt, right_max_bin, right_cnt, min_data_in_bin)
+
+    bounds: List[float] = []
+    if left_cnt_data > 0 and max_bin > 1:
+        denom = total_sample_cnt - zero_cnt
+        left_max_bin = max(
+            1, int(left_cnt_data / max(denom, 1) * (max_bin - 1))
         )
+        dv, cnt = np.unique(neg, return_counts=True)
+        bounds = greedy_find_bin(
+            dv, cnt, left_max_bin, left_cnt_data, min_data_in_bin
+        )
+        if bounds:
+            bounds[-1] = -K_ZERO_THRESHOLD
+    right_max_bin = max_bin - 1 - len(bounds)
+    if right_cnt_data > 0 and right_max_bin > 0:
+        dv, cnt = np.unique(pos, return_counts=True)
+        right_bounds = greedy_find_bin(
+            dv, cnt, right_max_bin, right_cnt_data, min_data_in_bin
+        )
+        bounds.append(K_ZERO_THRESHOLD)
+        bounds.extend(right_bounds)
     else:
         bounds.append(float("inf"))
-    # dedupe & sort defensively
-    out = sorted(set(bounds))
-    if out[-1] != float("inf"):
-        out.append(float("inf"))
-    return out
+    return bounds
 
 
 @dataclass

@@ -205,6 +205,19 @@ class GBDT:
             if HIST_BLK % n_dev != 0 or jax.devices()[0].platform == "tpu":
                 blk = HIST_BLK * n_dev  # per-shard rows stay pallas-aligned
             train_set.ensure_row_block(blk)
+            if jax.process_count() > 1:
+                # pre-partitioned ranks hold UNEVEN shards; NamedSharding
+                # tiles evenly, so every rank pads to the cluster-wide
+                # max — AFTER the final row_block is set above (padded
+                # counts are row_block multiples, identical across
+                # ranks, so their max is too)
+                from jax.experimental import multihost_utils
+
+                padded = np.asarray(train_set.num_rows_padded(), np.int64)
+                target = int(np.max(
+                    multihost_utils.process_allgather(padded)
+                ))
+                train_set.ensure_min_padded_rows(target)
         elif config.tree_learner == "feature" and n_dev > 1:
             if train_set.bundle_layout is not None:
                 log.warning(
@@ -237,19 +250,11 @@ class GBDT:
             and m.num_bin > config.max_cat_to_onehot
             for m in train_set.used_mappers()
         )
+        # voting composes with EFB: the election unit is the bundle
+        # column (permuted.py voting block), so no bundle guard here
         use_voting = (
-            config.tree_learner == "voting"
-            and self._mesh is not None
-            and train_set.bundle_layout is None
+            config.tree_learner == "voting" and self._mesh is not None
         )
-        if (config.tree_learner == "voting" and self._mesh is not None
-                and train_set.bundle_layout is not None):
-            log.warning(
-                "tree_learner=voting is disabled because EFB bundled this "
-                "dataset (feature != column); falling back to full "
-                "histogram psum (tree_learner=data). Set "
-                "enable_bundle=false to use the voting election."
-            )
         # ---- per-node extras: extra_trees, feature_fraction_bynode,
         # interaction constraints, CEGB (permuted sequential path only)
         from .config import parse_interaction_constraints
@@ -438,17 +443,59 @@ class GBDT:
 
             from .parallel.data_parallel import DataParallelGrower
 
+            if jax.process_count() > 1:
+                # multi-controller cluster: the fused loop closes over
+                # the dataset arrays, which is illegal for arrays
+                # spanning non-addressable devices — ride the sync path
+                # (every jit takes the global arrays as arguments).
+                self._force_sync = True
+                if self.config.bagging_freq > 0 and \
+                        self.config.bagging_fraction < 1.0:
+                    log.warning(
+                        "bagging under multi-host training is not yet "
+                        "global-row aware; disabling bagging"
+                    )
+                    self.config.bagging_freq = 0
+
             self._dp = DataParallelGrower(self._mesh, self.spec)
             self.dev = self._dp.shard_inputs(self.dev)
             # free the unsharded device copies — this booster reads only
             # self.dev for the train set; other boosters re-push fresh
             train_set.invalidate_device_cache()
-            row = NamedSharding(self._mesh, P(None, "data"))
-            self.train.score = jax.device_put(self.train.score, row)
-            if self._label_dev is not None:
-                self._label_dev = jax.device_put(
-                    self._label_dev, NamedSharding(self._mesh, P("data"))
+            if jax.process_count() > 1:
+                from .parallel.multihost import global_rows
+
+                self.train.score = global_rows(
+                    np.asarray(self.train.score), self._mesh, axis=1
                 )
+                if self._label_dev is not None:
+                    self._label_dev = global_rows(
+                        np.asarray(self._label_dev), self._mesh, axis=0
+                    )
+                # objective per-row device arrays follow the same global
+                # row sharding (each rank contributed its shard). The
+                # HOST statistics (_bfs_label & friends) must cache
+                # BEFORE the swap: afterwards np.asarray on the global
+                # arrays would raise (non-addressable shards)
+                o = self.objective
+                if o is not None:
+                    o._bfs_label()
+                    o._np_weight()
+                    if getattr(o, "_label_weight", None) is not None:
+                        o._bfs_label_weight()
+                    for attr in ("label", "weight", "_label_weight"):
+                        a = getattr(o, attr, None)
+                        if a is not None:
+                            setattr(o, attr, global_rows(
+                                np.asarray(a), self._mesh, axis=0
+                            ))
+            else:
+                row = NamedSharding(self._mesh, P(None, "data"))
+                self.train.score = jax.device_put(self.train.score, row)
+                if self._label_dev is not None:
+                    self._label_dev = jax.device_put(
+                        self._label_dev, NamedSharding(self._mesh, P("data"))
+                    )
         elif self._parallel_mode == "feature":
             from .parallel.feature_parallel import FeatureParallelGrower
 
@@ -1199,23 +1246,51 @@ class GBDT:
         """Percentile leaf refit for l1/huber/quantile/mape
         (RegressionL1loss::RenewTreeOutput). RF passes its own residuals
         (label - init score, rf.hpp residual_getter)."""
+        import jax
         import jax.numpy as jnp
 
         ds = self.train_set
-        n = ds.num_data
-        rl = np.asarray(row_leaf)[:n]
-        bag = np.asarray(mask)[:n] > 0
-        label = np.asarray(ds.metadata.label, dtype=np.float64)
-        if resid is None:
-            score = np.asarray(self.train.score[k])[:n].astype(np.float64)
-            resid = label - score
-        w = (
-            np.asarray(ds.metadata.weight, dtype=np.float64)
-            if ds.metadata.weight is not None
-            else np.ones(n)
-        )
-        if hasattr(self.objective, "_label_weight"):  # mape
-            w = np.asarray(self.objective._label_weight)[:n].astype(np.float64)
+        if jax.process_count() > 1:
+            # global-row view: fetch the sharded arrays whole and build
+            # label/weight in the same process-concatenated PADDED
+            # layout (padding rows carry mask 0, so `bag` drops them)
+            from .parallel.multihost import gather_host_rows, host_global_array
+
+            rl = host_global_array(row_leaf)
+            bag = host_global_array(mask) > 0
+            label = gather_host_rows(
+                ds.padded(ds.metadata.label).astype(np.float64)
+            )
+            if resid is None:
+                score = host_global_array(
+                    self.train.score[k]
+                ).astype(np.float64)
+                resid = label - score
+            if ds.metadata.weight is not None:
+                w = gather_host_rows(
+                    ds.padded(ds.metadata.weight).astype(np.float64)
+                )
+            else:
+                w = np.ones(len(label))
+            if hasattr(self.objective, "_label_weight"):  # mape
+                w = host_global_array(
+                    self.objective._label_weight
+                ).astype(np.float64)
+        else:
+            n = ds.num_data
+            rl = np.asarray(row_leaf)[:n]
+            bag = np.asarray(mask)[:n] > 0
+            label = np.asarray(ds.metadata.label, dtype=np.float64)
+            if resid is None:
+                score = np.asarray(self.train.score[k])[:n].astype(np.float64)
+                resid = label - score
+            w = (
+                np.asarray(ds.metadata.weight, dtype=np.float64)
+                if ds.metadata.weight is not None
+                else np.ones(n)
+            )
+            if hasattr(self.objective, "_label_weight"):  # mape
+                w = np.asarray(self.objective._label_weight)[:n].astype(np.float64)
         alpha = self.objective.renew_percentile()
         lv = np.asarray(arrays.leaf_value).copy()
         n_leaves = int(arrays.num_nodes) + 1
@@ -1288,6 +1363,83 @@ class GBDT:
     def current_iteration(self) -> int:
         return self.iter_
 
+    def _single_row_predictor(self, start: int, end: int):
+        """Packed low-latency predictor (c_api.cpp:66
+        SingleRowPredictorInner): all trees' node arrays stacked into
+        (T, M) matrices ONCE, so a single row walks every tree in
+        lockstep with ~max_depth vectorized steps instead of T Python
+        dispatches. Numeric splits only; categorical / linear models
+        return None (batch path). Cached per (start, end, model count)."""
+        key = (start, end, len(self.models))
+        cached = getattr(self, "_srp_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from .tree import _CAT_MASK
+
+        K = self.num_class
+        models = [self.models[it * K + k]
+                  for it in range(start, end) for k in range(K)]
+        if not models or any(
+            t.is_linear or (np.asarray(t.decision_type) & _CAT_MASK).any()
+            for t in models
+        ):
+            self._srp_cache = (key, None)
+            return None
+        T = len(models)
+        M = max(max(t.num_leaves - 1, 1) for t in models)
+        L = max(t.num_leaves for t in models)
+        feat = np.zeros((T, M), np.int64)
+        thr = np.zeros((T, M), np.float64)
+        mt = np.zeros((T, M), np.int8)  # missing type
+        dl = np.zeros((T, M), bool)  # default left
+        lc = np.zeros((T, M), np.int64)
+        rc = np.zeros((T, M), np.int64)
+        lv = np.zeros((T, L), np.float64)
+        cls = np.zeros(T, np.int64)
+        cur0 = np.zeros(T, np.int64)
+        for t, m in enumerate(models):
+            n = max(m.num_leaves - 1, 0)
+            if n == 0:
+                cur0[t] = -1  # stump: straight to leaf 0
+            else:
+                feat[t, :n] = m.split_feature[:n]
+                thr[t, :n] = m.threshold[:n]
+                dt = np.asarray(m.decision_type[:n], np.int64)
+                mt[t, :n] = (dt >> 2) & 3
+                dl[t, :n] = (dt & 2) != 0
+                lc[t, :n] = m.left_child[:n]
+                rc[t, :n] = m.right_child[:n]
+            lv[t, : m.num_leaves] = m.leaf_value[: m.num_leaves]
+            cls[t] = t % K
+        srp = dict(feat=feat, thr=thr, mt=mt, dl=dl, lc=lc, rc=rc, lv=lv,
+                   cls=cls, cur0=cur0, T=T, K=K)
+        self._srp_cache = (key, srp)
+        return srp
+
+    def _predict_one_packed(self, srp, x: np.ndarray) -> np.ndarray:
+        """One row through the packed predictor -> (K,) raw margins."""
+        tidx = np.arange(srp["T"])
+        cur = srp["cur0"].copy()
+        active = cur >= 0
+        while active.any():
+            nodes = np.where(active, cur, 0)
+            f = srp["feat"][tidx, nodes]
+            v = x[f]
+            m = srp["mt"][tidx, nodes]
+            isna = np.isnan(v)
+            miss = np.where(m == 2, isna,
+                            (m == 1) & (isna | (np.abs(v) <= 1e-35)))
+            v = np.where(isna & (m != 2), 0.0, v)
+            gl = np.where(miss, srp["dl"][tidx, nodes],
+                          v <= srp["thr"][tidx, nodes])
+            nxt = np.where(gl, srp["lc"][tidx, nodes], srp["rc"][tidx, nodes])
+            cur = np.where(active, nxt, cur)
+            active = cur >= 0
+        vals = srp["lv"][tidx, ~cur]
+        out = np.zeros(srp["K"])
+        np.add.at(out, srp["cls"], vals)
+        return out
+
     def predict_raw(
         self,
         X: np.ndarray,
@@ -1307,6 +1459,16 @@ class GBDT:
         n_iters = len(self.models) // K
         end = n_iters if num_iteration <= 0 else min(n_iters, start_iteration + num_iteration)
         out = np.zeros((K, X.shape[0]))
+        if early_stop is None and X.shape[0] <= 4:
+            # latency path: a handful of rows costs less through the
+            # packed lockstep walk than through T per-tree dispatches
+            srp = self._single_row_predictor(start_iteration, end)
+            if srp is not None:
+                for r in range(X.shape[0]):
+                    out[:, r] = self._predict_one_packed(srp, X[r])
+                if self.average_output and end > start_iteration:
+                    out /= end - start_iteration
+                return out
         if early_stop is None:
             for it in range(start_iteration, end):
                 for k in range(K):

@@ -236,6 +236,13 @@ OBJECTIVE_ALIASES = {
 
 
 def _coerce(name: str, typ: Any, value: Any) -> Any:
+    if name == "interaction_constraints" and isinstance(value, (list, tuple)):
+        # the reference Python package accepts a list of lists and
+        # serializes it to the "[0,1,2],[3,4]" config-string form
+        # (basic.py _param_dict_to_str)
+        return ",".join(
+            "[" + ",".join(str(int(i)) for i in g) + "]" for g in value
+        )
     if typ is bool:
         if isinstance(value, bool):
             return value

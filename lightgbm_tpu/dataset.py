@@ -149,7 +149,10 @@ class BinnedDataset:
                     BinMapper.from_sample(
                         col,
                         total_sample_cnt=len(sample),
-                        max_bin=mb + 1,  # reference adds 1 slot: bin 0..max_bin
+                        # the reference passes config max_bin straight to
+                        # FindBin (dataset_loader.cpp:652) — num_bin ends
+                        # <= max_bin, NOT max_bin+1
+                        max_bin=mb,
                         min_data_in_bin=config.min_data_in_bin,
                         use_missing=config.use_missing,
                         zero_as_missing=config.zero_as_missing,
@@ -407,7 +410,21 @@ class BinnedDataset:
 
     def num_rows_padded(self) -> int:
         b = self.row_block
-        return ((self.num_data + b - 1) // b) * b
+        n = ((self.num_data + b - 1) // b) * b
+        return max(n, getattr(self, "_min_padded_rows", 0))
+
+    def ensure_min_padded_rows(self, target: int) -> None:
+        """Force the padded row count up to `target` (a row_block
+        multiple). Multi-host pre-partitioned training needs EQUAL
+        per-rank shards for the global mesh sharding — ranks pad to the
+        cluster-wide maximum (reference pre_partition keeps uneven
+        shards because its collectives carry explicit sizes;
+        NamedSharding tiles evenly)."""
+        if target % self.row_block != 0:
+            raise ValueError((target, self.row_block))
+        if target > self.num_rows_padded():
+            self._min_padded_rows = int(target)
+            self.invalidate_device_cache()
 
     def ensure_row_block(self, blk: int) -> None:
         """Raise the device row padding so per-shard rows stay a pallas

@@ -246,12 +246,18 @@ class DeviceEvalSet:
         w = _weights(weight, valid)
         fns = []
         ndcg_factory = None
+        map_factory = None
         for nm in metric_names:
             base = nm.split("@")[0]  # display names may carry "@k"
             if base == "ndcg":
                 if ndcg_factory is None:
                     ndcg_factory = _make_ndcg_factory(cfg, label, group)
                 fns.append((ndcg_factory(int(nm.split("@")[1])), False))
+                continue
+            if base == "map":
+                if map_factory is None:
+                    map_factory = _make_map_factory(cfg, label, group)
+                fns.append((map_factory(int(nm.split("@")[1])), False))
                 continue
             if num_class > 1 and base in ("multi_logloss", "multi_error"):
                 fns.append((_make_multiclass(base, cfg, label, w, num_class), True))
@@ -305,6 +311,26 @@ def _make_ndcg_factory(cfg: Config, label, group):
     return factory
 
 
+def _make_map_factory(cfg: Config, label, group):
+    """Device MAP@k (map_metric.hpp) over the shared (Q, M) layout —
+    keeps metric=map ranking configs on the fused device loop."""
+    import jax.numpy as jnp
+
+    from .learner.ranking import build_query_layout, map_at
+
+    npad = int(label.shape[0])
+    layout = build_query_layout(np.asarray(group), npad)
+    label_dev = jnp.asarray(label, jnp.float32)
+
+    def factory(k: int):
+        def f(s):
+            return map_at(layout, s, label_dev, [k])[0]
+
+        return f
+
+    return factory
+
+
 # metric names the device path supports (superset check happens at build)
 def supported_names(metric_objs) -> Optional[Tuple[List[str], List[bool]]]:
     """Map host Metric objects -> (display names, higher_better) if all
@@ -316,17 +342,17 @@ def supported_names(metric_objs) -> Optional[Tuple[List[str], List[bool]]]:
         "l2", "rmse", "l1", "quantile", "huber", "fair", "poisson", "mape",
         "gamma", "gamma_deviance", "tweedie", "binary_logloss",
         "binary_error", "cross_entropy", "auc", "multi_logloss",
-        "multi_error", "ndcg",
+        "multi_error", "ndcg", "map",
     }
     for m in metric_objs:
         if m.name not in _ok:
             return None
-        if m.name == "ndcg":
+        if m.name in ("ndcg", "map"):
             if getattr(m, "group", None) is None:
                 return None
             ks = list(m.config.eval_at) or [1, 2, 3, 4, 5]
             for k in ks:
-                names.append(f"ndcg@{k}")
+                names.append(f"{m.name}@{k}")
                 hb.append(True)
             continue
         display = m.name

@@ -183,8 +183,6 @@ def grow_tree_permuted(
     ax = spec.axis_name
     caps = segment_caps(N)
     Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
-    if spec.voting_k and spec.efb:
-        raise ValueError("voting_k requires EFB off (feature==column)")
     if spec.voting_k and spec.n_forced:
         # forced splits read s.hist[fl] at the prescribed feature without
         # a hist_valid gate; under voting non-elected columns hold stale
@@ -745,37 +743,53 @@ def grow_tree_permuted(
                 hg = lax.dynamic_slice(pgh, (jnp.int32(0), start), (8, cap))
                 iota = jnp.arange(cap, dtype=jnp.int32)
                 m = ((iota >= off) & (iota < off + small_cnt)).astype(jnp.float32)
-                return histogram(hb, hg * m[None, :], Bc)
+                hgm = hg * m[None, :]
+                s8 = jnp.sum(hgm, axis=1)
+                lsum = jnp.stack([s8[0] + s8[1], s8[2] + s8[3], s8[4]])
+                return histogram(hb, hgm, Bc), lsum
 
             return h
 
         hidx = jnp.clip(jnp.sum(caps_arr >= small_cnt) - 1, 0, len(caps) - 1)
-        small_hist = lax.switch(hidx, [mk_hist(cp) for cp in caps], None)
+        small_hist, lsum3 = lax.switch(hidx, [mk_hist(cp) for cp in caps], None)
         valid_parent = s.hist_valid[l]  # (F,)
         if spec.voting_k and ax is not None:
             # ---- voting election (GlobalVoting, parallel_tree_learner
-            # .h:152): each shard proposes its top-k features by LOCAL
+            # .h:152): each shard proposes its top-k COLUMNS by LOCAL
             # gain on the smaller child; votes + summed gains elect 2k;
-            # only elected columns cross the mesh
-            k = min(spec.voting_k, F)
-            k2 = min(2 * spec.voting_k, F)
-            lsums = jnp.sum(small_hist[:, 0, :], axis=-1)  # (3,) local
+            # only elected columns cross the mesh. Under EFB the unit of
+            # election is the bundle column (a bundle's gain = the best
+            # of its member features), so voting composes with bundling
+            # — the reference elects features because its storage unit
+            # is the feature group (voting_parallel_tree_learner.cpp).
+            kG = min(spec.voting_k, G)
+            k2 = min(2 * spec.voting_k, G)
             lgains = feature_best_gains(
-                small_hist, lsums[0], lsums[1], lsums[2], num_bins,
+                exp_hist(small_hist, lsum3[0], lsum3[1], lsum3[2]),
+                lsum3[0], lsum3[1], lsum3[2], num_bins,
                 nan_bin, mono, is_cat, params, feat_mask,
                 cat_subset=spec.cat_subset,
-            )
-            _, topi = lax.top_k(lgains, k)
-            in_topk = jnp.zeros(F, bool).at[topi].set(True)
+            )  # (F,) local per-feature gains
+            if spec.efb:
+                col_gain = jnp.full(G, NEG_INF).at[bundle.bundle_of].max(
+                    lgains
+                )
+            else:
+                col_gain = lgains
+            _, topi = lax.top_k(col_gain, kG)
+            in_topk = jnp.zeros(G, bool).at[topi].set(True)
             votes = lax.psum(in_topk.astype(jnp.float32), ax)
             score = lax.psum(
-                jnp.where(in_topk, jnp.maximum(lgains, 0.0), 0.0), ax
+                jnp.where(in_topk, jnp.maximum(col_gain, 0.0), 0.0), ax
             )
             _, eidx = lax.top_k(votes * 1e12 + score, k2)
-            elected = jnp.zeros(F, bool).at[eidx].set(True)
+            elected_cols = jnp.zeros(G, bool).at[eidx].set(True)
             comp = lax.psum(small_hist[:, eidx, :], ax)  # (3, 2k, B) wire
             small_hist = (
                 jnp.zeros_like(small_hist).at[:, eidx, :].set(comp)
+            )
+            elected = (
+                elected_cols[bundle.bundle_of] if spec.efb else elected_cols
             )
             valid_small = elected
             valid_large = elected & valid_parent

@@ -257,3 +257,42 @@ def ndcg_at(
         nd = jnp.where(idcg > 0, dcg / jnp.where(idcg > 0, idcg, 1.0), 1.0)
         out.append(jnp.mean(nd))
     return jnp.stack(out)
+
+
+def map_at(
+    layout: QueryLayout,
+    score,  # (npad,) device
+    label,  # (npad,) device
+    ks: List[int],
+):
+    """Device MAP@k for each k (src/metric/map_metric.hpp CalMapAtK):
+    binary relevance label > 0.5; AP@k = sum over relevant positions
+    j < k of hits(j)/(j+1), normalized by min(npos, k); queries with no
+    positives count 1.0. Mean over queries."""
+    import jax.numpy as jnp
+
+    qdoc = jnp.asarray(layout.qdoc)
+    qvalid = jnp.asarray(layout.qvalid)
+    npad = layout.npad
+    M = layout.max_docs
+    NEG = jnp.float32(-1e30)
+
+    s = jnp.where(qvalid, score[jnp.clip(qdoc, 0, npad - 1)], NEG)
+    lb = jnp.where(qvalid, label[jnp.clip(qdoc, 0, npad - 1)], 0.0)
+    order = jnp.argsort(-s, axis=1, stable=True)
+    rel = jnp.take_along_axis(lb, order, axis=1) > 0.5
+    sv = jnp.take_along_axis(qvalid, order, axis=1)
+    rel = rel & sv
+    hits = jnp.cumsum(rel.astype(jnp.float32), axis=1)
+    pos_idx = jnp.arange(M, dtype=jnp.float32)[None, :]
+    prec = jnp.where(rel, hits / (pos_idx + 1.0), 0.0)
+    npos = jnp.sum(rel, axis=1).astype(jnp.float32)
+
+    out = []
+    for k in ks:
+        kmask = (jnp.arange(M) < k)[None, :]
+        sum_ap = jnp.sum(jnp.where(kmask, prec, 0.0), axis=1)
+        denom = jnp.minimum(npos, float(k))
+        ap = jnp.where(npos > 0, sum_ap / jnp.maximum(denom, 1.0), 1.0)
+        out.append(jnp.mean(ap))
+    return jnp.stack(out)

@@ -359,6 +359,15 @@ def _best_split_impl(
     if cat_subset:
         ok_cat &= (num_bins <= params.max_cat_to_onehot)[:, None]
 
+    if rand_bin is not None:
+        # extra_trees: one random numerical threshold per feature per
+        # node (col_sampler / feature_histogram extra-trees scan); the
+        # categorical directions keep their full search. Applied in
+        # ORIGINAL bin space, before the tie-break reindexing below.
+        rb_ok = bin_idx == rand_bin[:, None]
+        ok_dr &= rb_ok
+        ok_dl &= rb_ok
+
     parent_gain_plain = leaf_gain(sum_g, sum_h, params)
     parent_gain = jnp.where(
         params.path_smooth > 0.0,
@@ -367,14 +376,37 @@ def _best_split_impl(
     )
     shift = parent_gain + params.min_gain_to_split
 
+    # ---- tie-breaking mirrors the reference scan order exactly
+    # (feature_histogram.hpp:396-441 FindBestThresholdSequentially):
+    # the REVERSE scan runs first (t descending -> on equal gain the
+    # HIGHEST threshold wins, and it owns the default-left direction),
+    # the forward scan second and replacing only on strictly greater
+    # gain; missing-type-None features run ONLY the reverse scan. We
+    # express this inside one argmax by reindexing the bin axis so the
+    # preferred candidate of any tie has the lowest flat index: the
+    # default-left direction is stored bin-flipped and stacked first,
+    # and the default-right direction is bin-flipped for features with
+    # no NaN bin (whose single reference scan is the reverse one).
+    no_nan = ~has_nan  # (F, 1)
+    bin_rev = jnp.clip(last_real - 1 - bin_idx, 0, B - 1)  # (F, B)
+
+    def flipb(a):
+        return jnp.take_along_axis(a, bin_rev, axis=1)
+
+    gain_dl_s = flipb(gain_dl)
+    ok_dl_s = flipb(ok_dl)
+    gain_dr_s = jnp.where(no_nan, flipb(gain_dr), gain_dr)
+    ok_dr_s = jnp.where(no_nan, flipb(ok_dr), ok_dr)
+
     # stack: dir axis LAST in flat order (F, B, D) so ties break on
-    # feature, then bin, then (dr, dl, cat[, cat_asc, cat_desc]).
-    # Deviation from the reference on EXACT float ties only: it scans all
+    # feature, then (reindexed) bin, then
+    # (dl, dr, cat[, cat_asc, cat_desc]). Categorical-subset deviation
+    # from the reference on EXACT float ties only: it scans all
     # ascending subset prefixes before any descending one
     # (feature_histogram.cpp:276), while this order interleaves
     # directions per prefix length.
-    dirs = [gain_dr, gain_dl, gain_cat]
-    oks = [ok_dr, ok_dl, ok_cat]
+    dirs = [gain_dl_s, gain_dr_s, gain_cat]
+    oks = [ok_dl_s, ok_dr_s, ok_cat]
     if cat_subset:
         big = is_cat & (num_bins > params.max_cat_to_onehot)
         cs_gain, cs_ok, cs_sums, inv_rank, valid_bin, cs_used = _cat_subset_scan(
@@ -388,11 +420,6 @@ def _best_split_impl(
     ok = jnp.stack(oks, axis=-1)
     if feat_mask is not None:
         ok &= feat_mask[:, None, None]
-    if rand_bin is not None:
-        # extra_trees: one random numerical threshold per feature per
-        # node (col_sampler / feature_histogram extra-trees scan); the
-        # categorical directions keep their full search
-        ok &= is_cat[:, None, None] | (bin_idx == rand_bin[:, None])[:, :, None]
     gains = jnp.where(ok, gains, NEG_INF)
     if penalty is not None:
         # CEGB DeltaGain (cost_effective_gradient_boosting.hpp:79):
@@ -405,8 +432,14 @@ def _best_split_impl(
     f = (idx // (B * D)).astype(jnp.int32)
     b = ((idx // D) % B).astype(jnp.int32)
     d = (idx % D).astype(jnp.int32)
-    default_left = d == 1
+    default_left = d == 0
     cat = d >= 2
+    # undo the tie-break bin reindexing (numerical dirs only)
+    lr_f = last_real[f, 0]
+    was_flipped = (d == 0) | ((d == 1) & (nan_bin[f] < 0))
+    b = jnp.where(
+        was_flipped & ~cat, jnp.clip(lr_f - 1 - b, 0, B - 1), b
+    ).astype(jnp.int32)
 
     lg_num = cg[f, b] + jnp.where(default_left, nan_g[f, 0], 0.0)
     lh_num = ch[f, b] + jnp.where(default_left, nan_h[f, 0], 0.0)

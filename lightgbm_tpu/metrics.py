@@ -225,6 +225,66 @@ class AveragePrecisionMetric(Metric):
         return [(self.name, ap, True)]
 
 
+class AucMuMetric(Metric):
+    """Multi-class AUC-mu (src/metric/multiclass_metric.hpp:183,
+    Kleiman & Page 2019): for each class pair (i, j) rank the pair's
+    rows by the separating direction v = w_i - w_j projected onto the
+    prediction vectors, compute the pairwise AUC with the reference's
+    kEpsilon tie handling, and average over pairs."""
+
+    name = "auc_mu"
+    higher_better = True
+
+    def eval(self, score):
+        K = self.config.num_class
+        y = self.label.astype(np.int64)
+        N = len(y)
+        w = self.weight
+        # weights matrix (config.cpp:225 GetAucMuWeights)
+        amw = list(self.config.auc_mu_weights)
+        if amw:
+            W = np.asarray(amw, np.float64).reshape(K, K)
+            np.fill_diagonal(W, 0.0)
+        else:
+            W = np.ones((K, K)) - np.eye(K)
+        S = np.asarray(score, np.float64).reshape(K, N)
+        eps = 1e-15  # reference kEpsilon
+        total = 0.0
+        for i in range(K):
+            for j in range(i + 1, K):
+                sel = (y == i) | (y == j)
+                if not np.any(y[sel] == i) or not np.any(y[sel] == j):
+                    continue
+                v = W[i] - W[j]
+                t1 = v[i] - v[j]
+                d = t1 * (v @ S[:, sel])
+                lab = y[sel]
+                ws = w[sel] if w is not None else np.ones(sel.sum())
+                # ascending distance; exact ties put class j first
+                order = np.lexsort((-lab, d))
+                d, lab, ws = d[order], lab[order], ws[order]
+                s_ij = num_j = num_cur_j = 0.0
+                last_j = 0.0
+                for k in range(len(d)):
+                    tie = abs(d[k] - last_j) < eps
+                    if lab[k] == i:
+                        s_ij += ws[k] * (
+                            num_j - 0.5 * num_cur_j if tie else num_j
+                        )
+                    else:
+                        num_j += ws[k]
+                        if tie:
+                            num_cur_j += ws[k]
+                        else:
+                            last_j = d[k]
+                            num_cur_j = ws[k]
+                wi = np.sum(ws[lab == i])
+                wj = np.sum(ws[lab == j])
+                total += (s_ij / wi) / wj
+        val = 2.0 * total / K / (K - 1)
+        return [(self.name, float(val), True)]
+
+
 class MultiLoglossMetric(Metric):
     name = "multi_logloss"
 
@@ -302,18 +362,23 @@ class MapMetric(Metric):
         ks = list(self.config.eval_at) or [1, 2, 3, 4, 5]
         results = {k: [] for k in ks}
         for q in range(len(qb) - 1):
-            lab = (self.label[qb[q]: qb[q + 1]] > 0).astype(np.float64)
+            # reference map_metric.hpp CalMapAtK: relevance is
+            # label > 0.5, the normalizer is min(TOTAL positives in the
+            # query, k) — not positives within the top k — and queries
+            # with no positives count as 1.0
+            lab = (self.label[qb[q]: qb[q + 1]] > 0.5).astype(np.float64)
             sc = score[qb[q]: qb[q + 1]]
             order = np.argsort(-sc, kind="stable")
             rel = lab[order]
+            npos = float(np.sum(rel))
             for k in ks:
                 kk = min(k, len(rel))
                 hits = np.cumsum(rel[:kk])
-                denom = np.sum(rel[:kk])
-                if denom > 0:
-                    ap = np.sum(hits / np.arange(1, kk + 1) * rel[:kk]) / denom
+                if npos > 0:
+                    ap = (np.sum(hits / np.arange(1, kk + 1) * rel[:kk])
+                          / min(npos, kk))
                 else:
-                    ap = 0.0
+                    ap = 1.0
                 results[k].append(ap)
         return [(f"map@{k}", float(np.mean(results[k])), True) for k in ks]
 
@@ -339,6 +404,7 @@ _METRICS: Dict[str, type] = {
     "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
     "softmax": MultiLoglossMetric, "multiclassova": MultiLoglossMetric,
     "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
     "cross_entropy": CrossEntropyMetric, "xentropy": CrossEntropyMetric,
     "ndcg": NDCGMetric, "lambdarank": NDCGMetric, "rank_xendcg": NDCGMetric,
     "map": MapMetric, "mean_average_precision": MapMetric,

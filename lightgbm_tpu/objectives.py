@@ -75,13 +75,42 @@ class ObjectiveFunction:
             return g * self.weight, h * self.weight
         return g, h
 
+    def _bfs_label(self):
+        """Host label for init-score statistics — GLOBAL across the
+        process cluster: under multi-host training every rank must
+        derive the SAME boost_from_average value (the reference's
+        BoostFromAverage is computed after the network allreduce,
+        gbdt.cpp); gathered lazily and cached."""
+        if getattr(self, "_g_label", None) is None:
+            from .parallel.multihost import gather_host_rows
+
+            self._g_label = gather_host_rows(
+                np.asarray(self.label)[: self._num_data]
+            )
+        return self._g_label
+
     def _np_weight(self):
-        """Host weights truncated to real rows (None when unweighted)."""
-        return (
-            np.asarray(self.weight)[: self._num_data]
-            if self.weight is not None
-            else None
-        )
+        """Host weights truncated to real rows (None when unweighted),
+        globally gathered like _bfs_label."""
+        if self.weight is None:
+            return None
+        if getattr(self, "_g_weight", None) is None:
+            from .parallel.multihost import gather_host_rows
+
+            self._g_weight = gather_host_rows(
+                np.asarray(self.weight)[: self._num_data]
+            )
+        return self._g_weight
+
+    def _bfs_label_weight(self):
+        """Objective-derived per-row weights (e.g. MAPE), gathered."""
+        if getattr(self, "_g_label_weight", None) is None:
+            from .parallel.multihost import gather_host_rows
+
+            self._g_label_weight = gather_host_rows(
+                np.asarray(self._label_weight)[: self._num_data]
+            )
+        return self._g_label_weight
 
 
 # ---------------------------------------------------------------- regression
@@ -100,7 +129,7 @@ class RegressionL2(ObjectiveFunction):
         return self._w(score - self.label, jnp.ones_like(score))
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         w = self._np_weight()
         return float(np.average(lab, weights=w))
 
@@ -118,7 +147,7 @@ class RegressionL1(RegressionL2):
         return self._w(jnp.sign(score - self.label), jnp.ones_like(score))
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         w = self._np_weight()
         if w is None:
             return float(np.percentile(lab, 50))
@@ -166,7 +195,7 @@ class Poisson(RegressionL2):
         return self._w(jnp.exp(score) - self.label, jnp.exp(score + mds))
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         return float(np.log(max(np.average(lab, weights=self._np_weight()), 1e-20)))
 
     def convert_output(self, score):
@@ -183,7 +212,7 @@ class Quantile(RegressionL2):
         return self._w(g, jnp.ones_like(score))
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         w = self._np_weight()
         if w is None:
             return float(np.percentile(lab, self.config.alpha * 100))
@@ -210,8 +239,8 @@ class MAPE(RegressionL2):
         return g, self._label_weight
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
-        w = np.asarray(self._label_weight)[: self._num_data]
+        lab = self._bfs_label()
+        w = self._bfs_label_weight()
         return _weighted_percentile(lab, w, 0.5)
 
     def renew_percentile(self) -> float:
@@ -253,7 +282,7 @@ class Binary(ObjectiveFunction):
 
     def init(self, dataset):
         super().init(dataset)
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         cnt_pos = float(np.sum(lab == 1))
         cnt_neg = float(np.sum(lab == 0))
         if self.config.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
@@ -276,9 +305,9 @@ class Binary(ObjectiveFunction):
         return self._w(g, h)
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         w = (
-            np.asarray(self.weight)[: self._num_data]
+            self._np_weight()
             if self.weight is not None
             else np.ones_like(lab)
         )
@@ -342,7 +371,7 @@ class MulticlassOVA(ObjectiveFunction):
         return g, h
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         p = float(np.mean(lab == class_id))
         p = min(max(p, 1e-15), 1.0 - 1e-15)
         return float(np.log(p / (1.0 - p)) / self.config.sigmoid)
@@ -366,7 +395,7 @@ class CrossEntropy(ObjectiveFunction):
         return self._w(p - self.label, p * (1.0 - p))
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         pavg = float(np.average(lab, weights=self._np_weight()))
         pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
         return float(np.log(pavg / (1.0 - pavg)))
@@ -417,7 +446,7 @@ class CrossEntropyLambda(ObjectiveFunction):
         return g, h
 
     def boost_from_score(self, class_id: int) -> float:
-        lab = np.asarray(self.label)[: self._num_data]
+        lab = self._bfs_label()
         havg = float(np.average(lab, weights=self._np_weight()))
         return float(np.log(max(np.expm1(havg), 1e-15)))
 

@@ -91,31 +91,53 @@ class DataParallelGrower:
         )
 
     def shard_inputs(self, dev: dict) -> dict:
-        """device_put the dataset arrays with the right shardings."""
+        """device_put the dataset arrays with the right shardings.
+
+        Multi-process clusters (jax.distributed): per-row arrays are
+        PROCESS-LOCAL shards assembled into global arrays
+        (pre_partition=true semantics, each rank contributed its rows);
+        single-process meshes device_put directly."""
         from ..learner.histogram import HIST_BLK
 
         n_dev = self.mesh.devices.size
         n_rows = dev["bins"].shape[1]
         platform = jax.devices()[0].platform
-        if platform == "tpu" and (n_rows // n_dev) % HIST_BLK != 0:
+        multiproc = jax.process_count() > 1
+        local_dev = n_dev // jax.process_count() if multiproc else n_dev
+        if platform == "tpu" and (n_rows // max(local_dev, 1)) % HIST_BLK != 0:
             from .. import log
 
             log.warning(
-                f"per-shard rows ({n_rows}/{n_dev}) are not a multiple of the "
-                f"pallas histogram block ({HIST_BLK}); histograms will use the "
-                f"slow einsum fallback — pad rows to row_block*num_devices"
+                f"per-shard rows ({n_rows}/{local_dev}) are not a multiple of "
+                f"the pallas histogram block ({HIST_BLK}); histograms will use "
+                f"the slow einsum fallback — pad rows to row_block*num_devices"
             )
         row = NamedSharding(self.mesh, P(self.axis_name))
         rep = NamedSharding(self.mesh, P())
         out = dict(dev)
-        out["bins"] = jax.device_put(
-            dev["bins"], NamedSharding(self.mesh, P(None, self.axis_name))
-        )
-        out["valid"] = jax.device_put(dev["valid"], row)
+        if multiproc:
+            from .multihost import global_rows
+
+            def put_rep(a):
+                return jax.make_array_from_process_local_data(
+                    rep, np.asarray(a)
+                )
+
+            out["bins"] = global_rows(np.asarray(dev["bins"]), self.mesh, axis=1)
+            out["valid"] = global_rows(np.asarray(dev["valid"]), self.mesh, axis=0)
+        else:
+
+            def put_rep(a):
+                return jax.device_put(a, rep)
+
+            out["bins"] = jax.device_put(
+                dev["bins"], NamedSharding(self.mesh, P(None, self.axis_name))
+            )
+            out["valid"] = jax.device_put(dev["valid"], row)
         for k in ("nan_bin", "num_bins", "mono", "is_cat"):
-            out[k] = jax.device_put(dev[k], rep)
+            out[k] = put_rep(dev[k])
         if dev.get("bundle") is not None:
-            out["bundle"] = jax.device_put(dev["bundle"], rep)
+            out["bundle"] = jax.tree.map(put_rep, dev["bundle"])
         return out
 
 

@@ -100,16 +100,50 @@ def init_distributed(
     return rank
 
 
+def gather_host_rows(arr: np.ndarray) -> np.ndarray:
+    """Allgather a per-process host array (1-D or row-major N-D) with
+    UNEVEN leading lengths into the process-order concatenation (every
+    rank returns the same array): rows are padded to the cluster max and
+    trimmed back after the gather. Used for global init-score statistics
+    (gbdt.cpp BoostFromAverage must produce ONE value per cluster) and
+    the distributed binning sample."""
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(arr)
+    n = arr.shape[0]
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray(n, np.int64))
+    ).reshape(-1)
+    mx = int(counts.max())
+    pad = np.zeros((mx,) + arr.shape[1:], arr.dtype)
+    pad[:n] = arr
+    g = np.asarray(multihost_utils.process_allgather(pad))  # (P, mx, ...)
+    return np.concatenate([g[i, : counts[i]] for i in range(len(counts))])
+
+
 def allgather_binning_sample(sample: np.ndarray) -> np.ndarray:
     """Concatenate every process's binning sample (rows) so all ranks
     derive identical BinMappers (dataset_loader.cpp:1174-1250)."""
+    return gather_host_rows(sample)
+
+
+def host_global_array(a) -> np.ndarray:
+    """Full host copy of a (possibly globally-sharded) device array on
+    EVERY process — np.asarray raises on arrays spanning other
+    processes' devices; those take a tiled process_allgather."""
     import jax
-    from jax.experimental import multihost_utils
 
     if jax.process_count() == 1:
-        return sample
-    gathered = multihost_utils.process_allgather(sample)
-    return np.asarray(gathered).reshape(-1, sample.shape[-1])
+        return np.asarray(a)
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+    return np.asarray(a)
 
 
 def global_rows(arr: np.ndarray, mesh, axis: int = 0):
@@ -126,3 +160,99 @@ def global_rows(arr: np.ndarray, mesh, axis: int = 0):
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
     return jax.make_array_from_process_local_data(sharding, arr)
+
+
+def run_distributed(
+    params: dict,
+    X: np.ndarray,
+    y: np.ndarray,
+    *,
+    machines: Optional[str] = None,
+    machine_list_file: Optional[str] = None,
+    machine_rank: Optional[int] = None,
+    num_machines: Optional[int] = None,
+    local_listen_port: int = 12400,
+    num_boost_round: int = 100,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    valid: Optional[tuple] = None,  # (Xv, yv) — rank-local validation shard
+    callbacks: Optional[list] = None,
+):
+    """One-call multi-host training — the python-package analog of
+    dask.py:415 `_train`: joins the cluster from reference-style network
+    params, builds IDENTICAL bin mappers on every rank from the
+    allgathered binning sample (dataset_loader.cpp:1174 distributed
+    binning), equalizes per-rank row padding for the global mesh, and
+    runs `lgb.train(tree_learner=data)` over all processes' devices.
+
+    `X`/`y` are THIS RANK's row shard (`pre_partition=true` semantics,
+    config.h). Returns the Booster — identical on every rank (lockstep
+    guarantee); save from rank 0.
+    """
+    import jax
+
+    from .. import engine
+    from ..basic import Dataset
+
+    rank = init_distributed(
+        machines=machines,
+        machine_list_file=machine_list_file,
+        num_machines=num_machines,
+        local_listen_port=local_listen_port,
+        machine_rank=machine_rank,
+    )
+
+    params = dict(params)
+    params.setdefault("tree_learner", "data")
+    params["num_machines"] = jax.process_count()
+
+    # ---- identical mappers everywhere: bin on the global sample
+    sample_cnt = int(params.get("bin_construct_sample_cnt", 200000))
+    per_rank = max(1, sample_cnt // max(jax.process_count(), 1))
+    if len(X) > per_rank:
+        rs = np.random.RandomState(int(params.get("data_random_seed", 1)))
+        idx = np.sort(rs.choice(len(X), per_rank, replace=False))
+        local_sample = np.ascontiguousarray(X[idx], dtype=np.float64)
+    else:
+        local_sample = np.ascontiguousarray(X, dtype=np.float64)
+    global_sample = allgather_binning_sample(local_sample)
+    bin_ref = Dataset(
+        global_sample,
+        label=np.zeros(len(global_sample)),
+        params={k: v for k, v in params.items()
+                if k not in ("tree_learner", "num_machines")},
+        free_raw_data=True,
+    )
+    bin_ref.construct()
+
+    ds = Dataset(
+        X, label=y, weight=weight, group=group,
+        reference=bin_ref, free_raw_data=False,
+    )
+    ds.construct()
+    # per-rank row-padding equalization happens inside GBDT setup
+    # (boosting.py data-parallel init) AFTER the final row_block is
+    # known — doing it here would be undone by ensure_row_block
+
+    valid_sets = None
+    valid_names = None
+    if valid is not None:
+        # every rank evaluates the FULL validation set (rank-local valid
+        # shards are allgathered) so metrics — and therefore early
+        # stopping — are identical across the cluster; the reference
+        # reaches the same property through its metric allreduce
+        Xv = allgather_binning_sample(
+            np.ascontiguousarray(valid[0], dtype=np.float64)
+        )
+        yv = gather_host_rows(np.asarray(valid[1], dtype=np.float64))
+        vs = Dataset(Xv, label=yv, reference=bin_ref, free_raw_data=False)
+        valid_sets = [vs]
+        valid_names = ["valid"]
+
+    bst = engine.train(
+        params, ds, num_boost_round=num_boost_round,
+        valid_sets=valid_sets, valid_names=valid_names,
+        callbacks=callbacks,
+    )
+    bst._distributed_rank = rank
+    return bst

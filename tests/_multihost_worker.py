@@ -97,7 +97,7 @@ def main() -> None:
         h = jnp.maximum(p * (1.0 - p), 1e-6) * valid
         return grower._fn(
             bins, nan_bin, num_bins, mono, is_cat, g, h, valid, feat_mask,
-            params, valid, None, None, None, None, None,
+            params, valid, None, None, None, None, None, None,
         )
 
     score = multihost.global_rows(np.zeros(npad_loc, np.float32), mesh)

@@ -54,3 +54,56 @@ def test_two_process_data_parallel_lockstep():
     ]
     sig = [ln.split("nodes=")[1] for ln in lines]
     assert sig[0] == sig[1], lines
+
+
+def test_two_process_full_train_api(tmp_path):
+    """run_distributed (the dask _train analog): 2 real processes, full
+    lgb.train — global binning, per-iteration eval, early stopping,
+    rank-0 save — byte-identical models on both ranks (VERDICT r3 #7)."""
+    worker = Path(__file__).parent / "_multihost_train_worker.py"
+    port = _free_port()
+    out_model = tmp_path / "dist_model.txt"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port),
+             str(out_model)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost train worker timed out")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {i} failed:\n{out[-3000:]}"
+        assert "MULTIHOST_TRAIN_OK" in out, out[-3000:]
+    lines = [
+        next(ln for ln in out.splitlines()
+             if ln.startswith("MULTIHOST_TRAIN_OK"))
+        for out in outs
+    ]
+    sigs = [dict(kv.split("=") for kv in ln.split()[1:]) for ln in lines]
+    assert sigs[0]["model"] == sigs[1]["model"], lines  # identical models
+    assert sigs[0]["best_it"] == sigs[1]["best_it"], lines
+    assert float(sigs[0]["auc"]) > 0.9, lines
+    l1 = [
+        next(ln for ln in out.splitlines()
+             if ln.startswith("MULTIHOST_L1_OK"))
+        for out in outs
+    ]
+    l1s = [dict(kv.split("=") for kv in ln.split()[1:]) for ln in l1]
+    assert l1s[0]["model"] == l1s[1]["model"], l1  # renewal objective too
+    assert out_model.exists()  # rank-0 save landed
+    # the saved model loads and predicts in THIS process
+    import lightgbm_tpu as lgb
+
+    bst = lgb.Booster(model_file=out_model)
+    assert np.isfinite(bst.predict(np.zeros((2, 8)))).all()

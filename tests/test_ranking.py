@@ -14,6 +14,7 @@ import pytest
 import jax.numpy as jnp
 
 import lightgbm_tpu as lgb
+import lightgbm_tpu.callback as cbm
 from lightgbm_tpu.learner.ranking import (
     build_query_layout,
     default_label_gain,
@@ -275,3 +276,51 @@ def test_lambdarank_position_bias():
     assert np.any(biases != 0.0)
     # later positions get lower (more negative) bias factors
     assert biases[0] > biases[-1]
+
+
+def test_device_map_matches_host_metric():
+    from lightgbm_tpu.learner.ranking import map_at
+
+    rs = np.random.RandomState(2)
+    group = np.asarray([10, 4, 8, 6])
+    n = int(group.sum())
+    npad = 32
+    label = np.zeros(npad)
+    label[:n] = (rs.rand(n) > 0.6).astype(float)
+    score = np.zeros(npad, np.float32)
+    score[:n] = rs.randn(n)
+    layout = build_query_layout(group, npad)
+
+    vals = np.asarray(map_at(
+        layout, jnp.asarray(score), jnp.asarray(label, jnp.float32),
+        [1, 3, 5],
+    ))
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import MapMetric
+
+    m = MapMetric(Config({"eval_at": [1, 3, 5]}))
+    m.init(label[:n], None, group)
+    host = m.eval(score[:n].astype(np.float64))
+    for (nm, hv, _), dv in zip(host, vals):
+        np.testing.assert_allclose(dv, hv, rtol=1e-5, atol=1e-6,
+                                   err_msg=nm)
+
+
+def test_map_metric_stays_fused():
+    """metric=map must keep lambdarank configs on the fused device loop
+    (VERDICT r3: host-only metrics silently fell off it)."""
+    X, y, group = _rank_problem()
+    params = dict(objective="lambdarank", num_leaves=15, min_data_in_leaf=3,
+                  metric="map", eval_at=[3, 5], verbosity=-1,
+                  lambdarank_position_bias=False)
+    params = {k: v for k, v in params.items()
+              if k != "lambdarank_position_bias"}
+    ds = lgb.Dataset(X, label=y, group=group, free_raw_data=False)
+    evals = {}
+    bst = lgb.train(params, ds, num_boost_round=8,
+                    valid_sets=[ds], valid_names=["tr"],
+                    callbacks=[cbm.record_evaluation(evals)])
+    assert bst._gbdt.fused_eligible()
+    assert "map@3" in evals["tr"] and len(evals["tr"]["map@3"]) == 8
+    assert evals["tr"]["map@5"][-1] > evals["tr"]["map@5"][0] - 1e-9
