@@ -238,6 +238,37 @@ class Dataset:
             if self.free_raw_data:
                 self.data = None
             return self
+        cfg0 = Config(self.params)
+        _sparse_names = (
+            [str(n) for n in self.feature_name]
+            if isinstance(self.feature_name, list)
+            else []
+        )
+        if (hasattr(self.data, "tocsc") and hasattr(self.data, "tocsr")
+                and not self._resolve_categorical(_sparse_names)
+                and not cfg0.linear_tree):
+            # scipy sparse: bin from column indices, never densify
+            # (sparse_bin.hpp:73 / dataset_loader.cpp:210 two_round)
+            names = _sparse_names or None
+            ref_binned = None
+            if self.reference is not None:
+                self.reference.construct()
+                ref_binned = self.reference._binned
+            with _gt.scope("dataset construct (sparse binning)"):
+                self._binned = BinnedDataset.from_csr(
+                    self.data,
+                    cfg0,
+                    label=self.label,
+                    weight=self.weight,
+                    group=self.group,
+                    init_score=self.init_score,
+                    position=self.position,
+                    feature_names=names,
+                    reference=ref_binned,
+                )
+            if self.free_raw_data:
+                self.data = None
+            return self
         arr, pandas_names = _to_2d_numpy(self.data)
         if isinstance(self.feature_name, list):
             names = [str(n) for n in self.feature_name]

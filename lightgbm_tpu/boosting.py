@@ -331,6 +331,8 @@ class GBDT:
                 "histogram psum (tree_learner=data)."
             )
             use_voting = False
+        if config.tpu_debug_check_split:
+            self._force_sync = True  # the check reads back per iteration
         if config.linear_tree:
             # leaf ridge fits run host-side per iteration (the reference
             # solves with Eigen on CPU too, linear_tree_learner.cpp:344)
@@ -367,6 +369,29 @@ class GBDT:
             jax.random.key(config.extra_seed) if (use_extra or use_bynode)
             else None
         )
+        # ---- monotone constraint method: intermediate/advanced ride
+        # the sequential permuted grower with per-split bound
+        # recomputation (mono_mode=1); they exclude per-node extras and
+        # voting (the re-search ignores their per-node state)
+        mono_any = (
+            train_set.monotone_constraints is not None
+            and np.any(np.asarray(train_set.monotone_constraints) != 0)
+        )
+        mono_mode = int(
+            mono_any
+            and config.monotone_constraints_method in ("intermediate",
+                                                       "advanced")
+        )
+        if mono_mode and (use_extra or use_bynode or use_cegb or n_groups
+                          or n_forced or use_voting
+                          or self._parallel_mode == "feature"):
+            log.warning(
+                "monotone_constraints_method=intermediate/advanced is "
+                "incompatible with per-node extras / forced splits / "
+                "voting / tree_learner=feature; falling back to "
+                "method=basic"
+            )
+            mono_mode = 0
         # ---- growth strategy (tpu_growth_mode): natural-order
         # round-batched growth is the TPU fast path; per-node extras,
         # forced splits, voting and feature-parallel ride the sequential
@@ -375,7 +400,7 @@ class GBDT:
             not use_voting
             and self._parallel_mode != "feature"
             and not (use_extra or use_bynode or use_cegb or n_groups
-                     or n_forced)
+                     or n_forced or mono_mode)
         )
         mode = config.tpu_growth_mode
         if mode == "auto":
@@ -415,6 +440,7 @@ class GBDT:
             # num_grad_quant_bins rides the dequantized 5-channel path
             quant=bool(use_rounds and config.use_quantized_grad
                        and config.num_grad_quant_bins <= 256),
+            mono_mode=mono_mode,
             voting_k=config.top_k if use_voting else 0,
             extra_trees=use_extra,
             ff_bynode=use_bynode,
@@ -869,6 +895,8 @@ class GBDT:
             arrays, row_leaf = self._grow_maybe_quantized(
                 gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
             )
+            if self.config.tpu_debug_check_split:
+                self._check_split(arrays, row_leaf, hk, mask)
             n_nodes = int(arrays.num_nodes)
             if n_nodes > 0:
                 should_continue = True
@@ -1239,6 +1267,51 @@ class GBDT:
             it * self.num_class + k,
         )
         return jax.random.permutation(fkey, F) < n
+
+    def _check_split(self, arrays, row_leaf, hk, mask) -> None:
+        """USE_DEBUG split validation (serial_tree_learner.h:174
+        CheckSplit / cuda_single_gpu_tree_learner.hpp:72
+        CheckSplitValid): recompute per-leaf counts and hessian sums
+        from the PARTITION (row->leaf) and assert they match the
+        histogram-derived tree arrays — catches kernel/partition drift
+        at the iteration it happens. Sync path only
+        (tpu_debug_check_split=true)."""
+        from .parallel.multihost import host_global_array
+
+        L = self.spec.num_leaves
+        rl = host_global_array(row_leaf)
+        m = host_global_array(mask)
+        h = host_global_array(hk)
+        n_nodes = int(arrays.num_nodes)
+        if n_nodes <= 0:
+            return
+        ok = (rl >= 0) & (m > 0)
+        cnt = np.bincount(rl[ok], minlength=L).astype(np.float64)
+        hw = (h * m).astype(np.float64)  # the grower sums hess * mask
+        hsum = np.bincount(rl[ok], weights=hw[ok], minlength=L)
+        if self.config.use_quantized_grad:
+            # quantized growth sums DISCRETIZED hessians; only the
+            # partition counts are comparable against raw hk
+            hsum = None
+        t_cnt = np.asarray(arrays.leaf_count, np.float64)
+        t_h = np.asarray(arrays.leaf_weight, np.float64)
+        nl = n_nodes + 1
+        if not np.allclose(cnt[:nl], t_cnt[:nl], atol=0.5):
+            bad = int(np.argmax(np.abs(cnt[:nl] - t_cnt[:nl])))
+            log.fatal(
+                f"CheckSplit: leaf {bad} partition count {cnt[bad]} != "
+                f"histogram-derived count {t_cnt[bad]} "
+                f"(iteration {self.iter_})"
+            )
+        if hsum is not None and not np.allclose(
+            hsum[:nl], t_h[:nl], rtol=1e-3, atol=1e-3
+        ):
+            bad = int(np.argmax(np.abs(hsum[:nl] - t_h[:nl])))
+            log.fatal(
+                f"CheckSplit: leaf {bad} partition hessian sum "
+                f"{hsum[bad]} != histogram-derived {t_h[bad]} "
+                f"(iteration {self.iter_})"
+            )
 
     def _renew_tree_output(
         self, arrays: TreeArrays, row_leaf, k: int, mask, resid=None

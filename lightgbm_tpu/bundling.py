@@ -127,6 +127,67 @@ def find_groups(
     return groups
 
 
+def find_groups_sparse(
+    nd_rows: List[Optional[np.ndarray]],  # sorted non-default row ids, or
+    # None when the feature must stay a dedicated column
+    num_bins: Sequence[int],
+    n_rows: int,
+    max_group_bins: int,
+) -> List[List[int]]:
+    """find_groups over SPARSE features: conflicts are sorted-index
+    intersections, group occupancy a sorted union — no (F, N) boolean
+    masks are ever materialized (the CSR ingestion path;
+    dataset.cpp:111 FindGroups semantics otherwise). Features whose
+    nd_rows is None (categorical, dense, most-freq bin != zero bin)
+    found singleton groups that accept no members."""
+    F = len(nd_rows)
+    budget = n_rows // 10000
+    cnts = np.array(
+        [n_rows if r is None else len(r) for r in nd_rows], np.int64
+    )
+    order = np.argsort(-cnts, kind="stable")
+
+    groups: List[List[int]] = []
+    group_rows: List[Optional[np.ndarray]] = []
+    group_bins: List[int] = []
+    group_conflict: List[int] = []
+    for f in order:
+        f = int(f)
+        width = int(num_bins[f]) - 1
+        placed = False
+        if nd_rows[f] is not None and cnts[f] < n_rows:
+            searched = 0
+            for gid in range(len(groups)):
+                if searched >= MAX_SEARCH_GROUP:
+                    break
+                if group_rows[gid] is None:
+                    continue  # founded by an unmergeable feature
+                if group_bins[gid] + width > max_group_bins:
+                    continue
+                rest = budget - group_conflict[gid]
+                if rest < 0:
+                    continue
+                searched += 1
+                cnt = np.intersect1d(
+                    group_rows[gid], nd_rows[f], assume_unique=True
+                ).size
+                if cnt <= rest and cnt <= cnts[f] // 2:
+                    groups[gid].append(f)
+                    group_rows[gid] = np.union1d(group_rows[gid], nd_rows[f])
+                    group_bins[gid] += width
+                    group_conflict[gid] += cnt
+                    placed = True
+                    break
+        if not placed:
+            groups.append([f])
+            group_rows.append(
+                nd_rows[f] if nd_rows[f] is not None else None
+            )
+            group_bins.append(1 + width)
+            group_conflict.append(0)
+    return groups
+
+
 def build_layout(
     groups: List[List[int]],
     num_bins: Sequence[int],

@@ -199,6 +199,10 @@ _PARAMS: Dict[str, _P] = {
     # under use_quantized_grad's 3 integer channels)
     "tpu_round_slots": (0, int, (), _nonneg),
     "tpu_hist_dtype": ("float32", str, (), None),
+    # USE_DEBUG split validation (serial_tree_learner.h:174 CheckSplit):
+    # recompute leaf counts/hessian sums from the partition each
+    # iteration and fatal on drift; forces the sync loop
+    "tpu_debug_check_split": (False, bool, (), None),
     "tpu_mesh_axes": ("data", str, (), None),
 }
 
@@ -469,8 +473,16 @@ def warn_unimplemented(cfg: "Config") -> None:
             active = v != inactive
         if active:
             log.warning(f"{name} is set but has no effect: {msg}")
-    if cfg.monotone_constraints_method not in ("basic",):
+    if cfg.monotone_constraints_method not in ("basic", "intermediate",
+                                               "advanced"):
         log.warning(
             f"monotone_constraints_method={cfg.monotone_constraints_method} "
-            "is not implemented; using 'basic' (interval inheritance)"
+            "is unknown; using 'basic' (interval inheritance)"
+        )
+    elif cfg.monotone_constraints_method == "advanced":
+        log.warning(
+            "monotone_constraints_method=advanced uses the intermediate "
+            "formulation (opposite-subtree output extrema recomputed per "
+            "split); the reference's per-threshold refinement "
+            "(monotone_constraints.hpp:858) is not replicated"
         )

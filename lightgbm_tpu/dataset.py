@@ -248,6 +248,208 @@ class BinnedDataset:
         )
 
     @staticmethod
+    def from_csr(
+        data,  # scipy sparse matrix (any format with tocsc/tocsr)
+        config: Config,
+        label: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        init_score: Optional[np.ndarray] = None,
+        position: Optional[np.ndarray] = None,
+        feature_names: Optional[Sequence[str]] = None,
+        reference: Optional["BinnedDataset"] = None,
+    ) -> "BinnedDataset":
+        """Sparse construction WITHOUT densifying the raw matrix.
+
+        The reference keeps sparse columns delta-encoded
+        (sparse_bin.hpp:73) and streams Criteo-scale text via two_round
+        (dataset_loader.cpp:210). Here: mappers bin each column's
+        NONZERO values (implicit zeros inferred from row counts — the
+        same inference FindBin does for its zero-omitting sample), EFB
+        conflict counts are sorted row-index intersections
+        (bundling.find_groups_sparse), and only the BUNDLED (G, N) bin
+        matrix is ever materialized — host peak is O(nnz) + the int
+        bundle matrix, never the 8-byte dense (N, F). Categorical
+        features and linear trees ride the dense path."""
+        csc = data.tocsc()
+        csc.sort_indices()
+        num_data, num_features = csc.shape
+
+        if reference is not None:
+            mappers = reference.mappers
+            if len(mappers) != num_features:
+                log.fatal("reference dataset has different number of features")
+            used = reference.used_features.copy()
+            max_num_bin = reference.max_num_bin
+            mono = reference.monotone_constraints
+        else:
+            rng = np.random.RandomState(config.data_random_seed)
+            sample_cnt = min(num_data, config.bin_construct_sample_cnt)
+            if sample_cnt < num_data:
+                idx = np.sort(rng.choice(num_data, sample_cnt, replace=False))
+                s_csc = data.tocsr()[idx].tocsc()
+            else:
+                s_csc = csc
+            mb_list = list(config.max_bin_by_feature)
+            mappers = []
+            for f in range(num_features):
+                vals = s_csc.data[s_csc.indptr[f]: s_csc.indptr[f + 1]]
+                mb = mb_list[f] if f < len(mb_list) else config.max_bin
+                mappers.append(
+                    BinMapper.from_sample(
+                        vals,
+                        total_sample_cnt=s_csc.shape[0],
+                        max_bin=mb,
+                        min_data_in_bin=config.min_data_in_bin,
+                        use_missing=config.use_missing,
+                        zero_as_missing=config.zero_as_missing,
+                    )
+                )
+            used = np.array(
+                [f for f in range(num_features) if not mappers[f].is_trivial],
+                dtype=np.int64,
+            )
+            if len(used) == 0:
+                log.fatal("cannot construct Dataset: all features are constant")
+            max_num_bin = max(mappers[f].num_bin for f in used)
+            mono = None
+            mc = list(config.monotone_constraints)
+            if mc:
+                if len(mc) != num_features:
+                    log.fatal(
+                        "monotone_constraints length must equal num features"
+                    )
+                mono = np.array([mc[f] for f in used], dtype=np.int8)
+
+        # per-used-feature nonzero (rows, bins) + non-default row sets
+        nz = []
+        nd_rows: List[Optional[np.ndarray]] = []
+        for f in used:
+            f = int(f)
+            lo, hi = csc.indptr[f], csc.indptr[f + 1]
+            rows = csc.indices[lo:hi]
+            b = mappers[f].values_to_bins(csc.data[lo:hi])
+            nz.append((rows, b))
+            m = mappers[f]
+            # mergeable only when the implicit zeros sit in the
+            # most-freq bin (merged columns never store that bin)
+            if m.most_freq_bin == m.default_bin:
+                nd_rows.append(np.asarray(rows[b != m.most_freq_bin]))
+            else:
+                nd_rows.append(None)
+
+        from .bundling import (
+            build_expand_idx,
+            build_layout,
+            find_groups_sparse,
+        )
+
+        um = [mappers[int(f)] for f in used]
+        u_bins = [m.num_bin for m in um]
+        if reference is not None:
+            bundle_layout = reference.bundle_layout
+            bundle_expand = reference.bundle_expand
+            groups = (
+                bundle_layout.groups if bundle_layout is not None
+                else [[i] for i in range(len(used))]
+            )
+            layout = bundle_layout
+        elif config.enable_bundle and len(used) > 1:
+            groups = find_groups_sparse(
+                nd_rows, u_bins, num_data,
+                max(config.max_bin + 1, 256),  # same cap as the dense path
+            )
+            if all(len(g) == 1 for g in groups):
+                layout = None
+                groups = [[i] for i in range(len(used))]
+            else:
+                layout = build_layout(groups, u_bins)
+                log.info(
+                    f"EFB (sparse): bundled {len(used)} features into "
+                    f"{layout.num_columns} columns "
+                    f"(col bins={layout.col_bins})"
+                )
+        else:
+            layout = None
+            groups = [[i] for i in range(len(used))]
+
+        col_bins = layout.col_bins if layout is not None else max_num_bin
+        dtype = _choose_bin_dtype(max(col_bins, max_num_bin))
+        G = len(groups)
+        bins = np.zeros((G, num_data), dtype=dtype)
+        mfb = np.full(len(used), -1, np.int32)
+        for gid, feats in enumerate(groups):
+            if len(feats) == 1:
+                i = feats[0]
+                rows, b = nz[i]
+                db = um[i].default_bin
+                if db != 0:
+                    bins[gid, :] = db
+                bins[gid, rows] = b.astype(dtype)
+                continue
+            col = bins[gid]
+            for i in feats:
+                rows, b = nz[i]
+                m = int(um[i].most_freq_bin)
+                mfb[i] = m
+                db = int(um[i].default_bin)
+                if db != m:
+                    # a reference layout built densely may merge a
+                    # feature whose most-freq bin is NOT the zero bin;
+                    # its IMPLICIT zero rows then carry default_bin and
+                    # must be offset-encoded like any non-mfb bin
+                    # (the fresh sparse path never merges such features)
+                    imp = np.setdiff1d(
+                        np.arange(num_data, dtype=rows.dtype), rows,
+                        assume_unique=True,
+                    )
+                    col[imp] = dtype(
+                        int(layout.off_lo[i]) + db - (db > m)
+                    )
+                ndm = b != m
+                shifted = b[ndm].astype(np.int64) - (b[ndm] > m)
+                col[rows[ndm]] = (layout.off_lo[i] + shifted).astype(dtype)
+        bundle_layout = None
+        bundle_expand = None
+        if layout is not None:
+            if reference is None:
+                layout = layout._replace(mfb=mfb)
+                bundle_expand = build_expand_idx(layout, u_bins, max_num_bin)
+            else:
+                bundle_expand = reference.bundle_expand
+            bundle_layout = layout
+
+        meta = Metadata(
+            label=None if label is None else np.asarray(label, dtype=np.float32).ravel(),
+            weight=None if weight is None else np.asarray(weight, dtype=np.float32).ravel(),
+            group=None if group is None else np.asarray(group, dtype=np.int64).ravel(),
+            init_score=None if init_score is None else np.asarray(init_score, dtype=np.float64).ravel(),
+            position=None if position is None else np.asarray(position, dtype=np.int32).ravel(),
+        )
+        meta.check(num_data)
+
+        row_block = config.tpu_row_block or DEFAULT_ROW_BLOCK
+        if row_block % HIST_BLK != 0:
+            row_block = ((row_block + HIST_BLK - 1) // HIST_BLK) * HIST_BLK
+        return BinnedDataset(
+            bins=bins,
+            mappers=mappers,
+            used_features=used,
+            num_data=num_data,
+            metadata=meta,
+            feature_names=(
+                list(feature_names) if feature_names is not None
+                else [f"Column_{i}" for i in range(num_features)]
+            ),
+            max_num_bin=max_num_bin,
+            row_block=row_block,
+            monotone_constraints=mono,
+            raw_data=None,
+            bundle_layout=bundle_layout,
+            bundle_expand=bundle_expand,
+        )
+
+    @staticmethod
     def from_sequences(
         seqs: Sequence[Any],
         config: Config,

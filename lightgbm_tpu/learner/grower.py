@@ -121,6 +121,17 @@ class GrowerSpec(NamedTuple):
     # the reference's int16/int32 histogram path (bin.h:63-81,
     # feature_histogram.hpp:1062 int threshold scan).
     quant: bool = False
+    # monotone constraint method (monotone_constraints_method):
+    # 0 = basic (children bounded at the split midpoint, inherited);
+    # 1 = intermediate/advanced (monotone_constraints.hpp:516): per-leaf
+    # bounds recomputed every split from the OPPOSITE subtrees' actual
+    # output extrema via an ancestry matrix, and every leaf's cached
+    # best split re-searched under the new bounds — less conservative
+    # than basic, still violation-free by induction. The reference's
+    # `advanced` per-threshold refinement (:858) is approximated by the
+    # same leaf-level bounds (documented deviation). Sequential permuted
+    # growth only.
+    mono_mode: int = 0
 
 
 class CegbInfo(NamedTuple):

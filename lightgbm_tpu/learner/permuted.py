@@ -116,6 +116,11 @@ class _PState(NamedTuple):
     # elected features); subtraction and search respect this mask.
     hist_valid: jax.Array
     extra: _Extras
+    # ancestry matrices for mono_mode=1 (intermediate constraints):
+    # anc_in[x, a] = leaf x lies in node a's subtree; anc_left[x, a] =
+    # on its LEFT side. Zero-size placeholders when mono_mode == 0.
+    anc_in: jax.Array  # (L, L-1) bool or (L, 0)
+    anc_left: jax.Array
 
 
 class _RState(NamedTuple):
@@ -191,6 +196,14 @@ def grow_tree_permuted(
     per_node = spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups
     if spec.rounds and (per_node or spec.n_forced):
         raise ValueError("tpu_growth_rounds excludes per-node extras")
+    if spec.mono_mode and (per_node or spec.voting_k or spec.n_forced
+                           or spec.rounds):
+        # the intermediate re-search pass uses the plain feature mask
+        # and assumes globally-valid histograms
+        raise ValueError(
+            "monotone intermediate/advanced excludes per-node extras / "
+            "voting / forced splits / rounds"
+        )
 
     def node_candidates(salt, child_groups, path_used_child, child_count,
                         feat_used):
@@ -524,6 +537,8 @@ def grow_tree_permuted(
             tree=tree_new,
             hist_valid=s.hist_valid,
             extra=s.extra,
+            anc_in=s.anc_in,
+            anc_left=s.anc_left,
         )
         return _RState(p=p_new, pleaf=pleaf_s)
 
@@ -553,6 +568,8 @@ def grow_tree_permuted(
         tree=tree,
         hist_valid=jnp.ones((L, F), bool),
         extra=extra0,
+        anc_in=jnp.zeros((L, L - 1 if spec.mono_mode else 0), bool),
+        anc_left=jnp.zeros((L, L - 1 if spec.mono_mode else 0), bool),
     )
 
     if spec.rounds and L > 2:
@@ -845,19 +862,92 @@ def grow_tree_permuted(
         else:
             rb_l = rb_r = pen_l = pen_r = None
             extra_new = s.extra
-        bl = best_split(exp_hist(left_hist, rec.left_g, rec.left_h, rec.left_c),
-                        rec.left_g, rec.left_h, rec.left_c,
-                        num_bins, nan_bin, mono, is_cat, params, fm_l,
-                        cat_subset=spec.cat_subset, parent_output=lo,
-                        cmin=lmin, cmax=lmax, penalty=pen_l, rand_bin=rb_l)
-        br = best_split(exp_hist(right_hist, rec.right_g, rec.right_h, rec.right_c),
-                        rec.right_g, rec.right_h, rec.right_c,
-                        num_bins, nan_bin, mono, is_cat, params, fm_r,
-                        cat_subset=spec.cat_subset, parent_output=ro,
-                        cmin=rmin, cmax=rmax, penalty=pen_r, rand_bin=rb_r)
-        depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
-        best2 = _set_best(s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF))
-        best2 = _set_best(best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF))
+        if not spec.mono_mode:
+            # mono_mode=1 re-searches EVERY leaf below (the children
+            # included) — computing bl/br here would be discarded work
+            bl = best_split(
+                exp_hist(left_hist, rec.left_g, rec.left_h, rec.left_c),
+                rec.left_g, rec.left_h, rec.left_c,
+                num_bins, nan_bin, mono, is_cat, params, fm_l,
+                cat_subset=spec.cat_subset, parent_output=lo,
+                cmin=lmin, cmax=lmax, penalty=pen_l, rand_bin=rb_l)
+            br = best_split(
+                exp_hist(right_hist, rec.right_g, rec.right_h, rec.right_c),
+                rec.right_g, rec.right_h, rec.right_c,
+                num_bins, nan_bin, mono, is_cat, params, fm_r,
+                cat_subset=spec.cat_subset, parent_output=ro,
+                cmin=rmin, cmax=rmax, penalty=pen_r, rand_bin=rb_r)
+            depth_ok = (spec.max_depth <= 0) | (depth_new < spec.max_depth)
+            best2 = _set_best(
+                s.best, l, bl, jnp.where(depth_ok, bl.gain, NEG_INF)
+            )
+            best2 = _set_best(
+                best2, new, br, jnp.where(depth_ok, br.gain, NEG_INF)
+            )
+        else:
+            best2 = s.best  # replaced by the re-search below
+
+        anc_in_new, anc_left_new = s.anc_in, s.anc_left
+        if spec.mono_mode:
+            # ---- intermediate constraints (monotone_constraints.hpp:516
+            # GoUpToFindLeavesToUpdate semantics, batch formulation):
+            # 1. extend the ancestry matrices with split i,
+            # 2. recompute EVERY leaf's [min, max] from the actual
+            #    output extrema of the opposite subtrees of its monotone
+            #    ancestors (tightest valid bounds; basic freezes the
+            #    midpoint instead),
+            # 3. re-search every leaf's best split under the new bounds
+            #    (the reference recomputes the leaves_to_update set; one
+            #    vmapped pass here keeps shapes static).
+            anc_in_new = (
+                s.anc_in.at[new].set(s.anc_in[l])
+                .at[l, i].set(True).at[new, i].set(True)
+            )
+            anc_left_new = (
+                s.anc_left.at[new].set(s.anc_left[l]).at[l, i].set(True)
+            )
+            t2 = tree_new
+            leaf_out2 = t2.leaf_value
+            valid_leaf = iota_L <= new
+            node_m = mono[t2.node_feature] * (
+                ~t2.node_cat
+            ).astype(jnp.int32)  # cat splits never constrain
+            node_alive = jnp.arange(L - 1) <= i
+            in_l = anc_in_new & anc_left_new & valid_leaf[:, None]
+            in_r = anc_in_new & ~anc_left_new & valid_leaf[:, None]
+            Lmax = jnp.max(jnp.where(in_l, leaf_out2[:, None], -BIG), axis=0)
+            Lmin = jnp.min(jnp.where(in_l, leaf_out2[:, None], BIG), axis=0)
+            Rmax = jnp.max(jnp.where(in_r, leaf_out2[:, None], -BIG), axis=0)
+            Rmin = jnp.min(jnp.where(in_r, leaf_out2[:, None], BIG), axis=0)
+            inc = (node_alive & (node_m > 0))[None, :]
+            dec = (node_alive & (node_m < 0))[None, :]
+            cmax_mat = jnp.where(in_l & inc, Rmin[None, :], BIG)
+            cmax_mat = jnp.where(in_r & dec, Lmin[None, :], cmax_mat)
+            cmin_mat = jnp.where(in_r & inc, Lmax[None, :], -BIG)
+            cmin_mat = jnp.where(in_l & dec, Rmax[None, :], cmin_mat)
+            nmax = jnp.min(cmax_mat, axis=1)  # (L,)
+            nmin = jnp.max(cmin_mat, axis=1)
+            lmin, lmax = nmin[l], nmax[l]
+            rmin, rmax = nmin[new], nmax[new]
+
+            def leaf_best(h_, g_, hh_, c_, po_, mn_, mx_):
+                return best_split(
+                    exp_hist(h_, g_, hh_, c_), g_, hh_, c_, num_bins,
+                    nan_bin, mono, is_cat, params, feat_mask,
+                    cat_subset=spec.cat_subset, parent_output=po_,
+                    cmin=mn_, cmax=mx_,
+                )
+
+            lg_all = s.leaf_g.at[l].set(rec.left_g).at[new].set(rec.right_g)
+            lh_all = s.leaf_h.at[l].set(rec.left_h).at[new].set(rec.right_h)
+            lc_all = s.leaf_c.at[l].set(rec.left_c).at[new].set(rec.right_c)
+            rec_all = jax.vmap(leaf_best)(
+                hist, lg_all, lh_all, lc_all, leaf_out2, nmin, nmax
+            )
+            d_ok = (spec.max_depth <= 0) | (t2.leaf_depth < spec.max_depth)
+            best2 = rec_all._replace(
+                gain=jnp.where(valid_leaf & d_ok, rec_all.gain, NEG_INF)
+            )
 
         return _PState(
             i=new,
@@ -871,12 +961,16 @@ def grow_tree_permuted(
             leaf_h=s.leaf_h.at[l].set(rec.left_h).at[new].set(rec.right_h),
             leaf_c=s.leaf_c.at[l].set(rec.left_c).at[new].set(rec.right_c),
             leaf_parent=s.leaf_parent.at[l].set(i).at[new].set(i),
-            leaf_min=s.leaf_min.at[l].set(lmin).at[new].set(rmin),
-            leaf_max=s.leaf_max.at[l].set(lmax).at[new].set(rmax),
+            leaf_min=(nmin if spec.mono_mode
+                      else s.leaf_min.at[l].set(lmin).at[new].set(rmin)),
+            leaf_max=(nmax if spec.mono_mode
+                      else s.leaf_max.at[l].set(lmax).at[new].set(rmax)),
             best=best2,
             tree=tree_new,
             hist_valid=hist_valid,
             extra=extra_new,
+            anc_in=anc_in_new,
+            anc_left=anc_left_new,
         )
 
     final = lax.while_loop(cond, body, state)

@@ -163,3 +163,50 @@ def test_unimplemented_params_warn(capsys):
     )
     text2 = capsys.readouterr().err
     assert "has no effect" not in text2
+
+
+@pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
+@pytest.mark.parametrize("direction", [1, -1])
+def test_monotone_methods_violation_scan(method, direction):
+    """Deep-tree violation scan for all three constraint methods
+    (monotone_constraints.hpp basic:489, intermediate:516,
+    advanced:858 — advanced maps onto the intermediate formulation)."""
+    rs = np.random.RandomState(5)
+    n = 4000
+    X = rs.randn(n, 4)
+    y = direction * (1.5 * X[:, 0] + 0.8 * np.sin(4 * X[:, 0])) \
+        + X[:, 1] + 0.2 * rs.randn(n)
+    mono = [direction, 0, 0, 0]
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 63, "verbosity": -1,
+         "monotone_constraints": mono, "learning_rate": 0.2,
+         "min_data_in_leaf": 3, "monotone_constraints_method": method},
+        ds, num_boost_round=10,
+    )
+    _check_monotone(bst, X, 0, direction)
+
+
+def test_monotone_intermediate_quality_at_least_basic():
+    """The intermediate method bounds children by the opposite
+    subtree's ACTUAL extrema instead of the frozen split midpoint —
+    strictly weaker constraints, so training loss must not regress
+    (reference docs: intermediate 'may slow the library very slightly'
+    but 'should improve the results')."""
+    rs = np.random.RandomState(8)
+    n = 5000
+    X = rs.randn(n, 4)
+    y = 1.2 * X[:, 0] + 0.6 * np.sin(3 * X[:, 0]) + 0.8 * X[:, 1] \
+        + 0.2 * rs.randn(n)
+    mse = {}
+    for method in ("basic", "intermediate"):
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst = lgb.train(
+            {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+             "monotone_constraints": [1, 0, 0, 0], "learning_rate": 0.15,
+             "min_data_in_leaf": 5,
+             "monotone_constraints_method": method},
+            ds, num_boost_round=20,
+        )
+        mse[method] = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse["intermediate"] <= mse["basic"] * 1.02, mse

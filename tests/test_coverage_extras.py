@@ -182,3 +182,37 @@ def test_single_row_fast_predict_matches_batch():
     b5 = bst.predict(Xq[:1], num_iteration=5)
     s5 = bst.predict(np.vstack([Xq[:1]] * 6), num_iteration=5)[:1]
     np.testing.assert_allclose(b5, s5, atol=1e-14)
+
+
+def test_debug_check_split_passes_and_detects():
+    """tpu_debug_check_split (serial_tree_learner.h:174 CheckSplit):
+    green on healthy training; a corrupted tree trips the fatal."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.log import LightGBMError
+
+    rs = np.random.RandomState(4)
+    X = rs.randn(3000, 6)
+    y = ((X[:, 0] + X[:, 1]) > 0).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "tpu_debug_check_split": True},
+        ds, num_boost_round=3,
+    )
+    assert bst.num_trees() == 3
+
+    # corrupt: a GBDT whose grower returns a wrong leaf_count
+    g = bst._gbdt
+    orig = g._grow_maybe_quantized
+
+    def bad(*a, **k):
+        arrays, rl = orig(*a, **k)
+        return arrays._replace(leaf_count=arrays.leaf_count + 7.0), rl
+
+    g._grow_maybe_quantized = bad
+    import pytest as _pytest
+
+    with _pytest.raises(LightGBMError, match="CheckSplit"):
+        g.train_one_iter(None, None)

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Runs the kernel microbenchmarks + the end-to-end bench on a live TPU;
+# appends everything to /tmp/tpu_measure.log (the builder folds results
+# into BENCH_NOTES.md).
+cd /root/repo
+echo "==== tpu_measure $(date -u) ===="
+timeout 1800 python tools/tpu_microbench.py 2>&1 | grep -v WARNING
+echo "==== bench.py auto (rounds) ===="
+timeout 1800 env BENCH_TREES=60 BENCH_WARMUP=2 python bench.py 2>bench_stderr.log
+tail -5 bench_stderr.log
+echo "==== bench.py quantized ===="
+timeout 1200 env BENCH_TREES=60 BENCH_WARMUP=2 BENCH_QUANT=1 python - << 'PYEOF' 2>&1 | tail -3
+import os, subprocess, sys
+os.environ.setdefault("BENCH_GROWTH_MODE", "auto")
+env = dict(os.environ)
+# quantized variant rides the same bench with use_quantized_grad
+src = open("bench.py").read().replace(
+    '"tpu_growth_mode": growth_mode,',
+    '"tpu_growth_mode": growth_mode, "use_quantized_grad": True,')
+open("/tmp/bench_quant.py", "w").write(src)
+r = subprocess.run([sys.executable, "/tmp/bench_quant.py"], capture_output=True, text=True, cwd="/root/repo")
+print(r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "no output")
+sys.stderr.write(r.stderr[-500:])
+PYEOF
+echo "==== done $(date -u) ===="
