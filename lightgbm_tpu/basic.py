@@ -742,8 +742,11 @@ class Booster:
         # containers and valids list are what must not be shared)
         import dataclasses as _dc
 
-        new_booster._gbdt.train = _dc.replace(self._gbdt.train)
-        new_booster._gbdt.valids = [_dc.replace(v) for v in self._gbdt.valids]
+        if hasattr(self._gbdt, "train"):
+            new_booster._gbdt.train = _dc.replace(self._gbdt.train)
+            new_booster._gbdt.valids = [
+                _dc.replace(v) for v in self._gbdt.valids
+            ]
         new_params = dict(self.config.explicit_params())
         new_params["refit_decay_rate"] = decay_rate
         new_booster.config = Config(new_params)

@@ -222,6 +222,57 @@ def _task_save_binary(params: Dict[str, str]) -> None:
     log.info(f"Finished saving binary dataset cache to {out}")
 
 
+def _task_convert_model(params: Dict[str, str]) -> None:
+    """task=convert_model (application.cpp:223 ConvertModel): model ->
+    if-else C++ source. convert_model_language=cpp is the only language
+    the reference supports too (config.h)."""
+    from . import Booster
+    from .model_io import model_to_if_else
+
+    lang = params.get("convert_model_language", "cpp")
+    if lang not in ("", "cpp"):
+        log.fatal(f"convert_model_language={lang} is not supported (cpp only)")
+    model_path = params.get("input_model", "LightGBM_model.txt")
+    if not Path(model_path).exists():
+        log.fatal(f"input model {model_path} does not exist")
+    bst = Booster(model_file=model_path)
+    out = params.get("convert_model", "gbdt_prediction.cpp")
+    Path(out).write_text(
+        model_to_if_else(bst._gbdt.models, bst._gbdt.num_class)
+    )
+    log.info(f"Finished converting model to if-else code at {out}")
+
+
+def _task_refit(params: Dict[str, str]) -> None:
+    """task=refit (config.h:35 kRefitTree): recompute the existing
+    model's leaf values from new data (Booster.refit)."""
+    from . import Booster
+    from .parsers import load_text_file
+
+    data_path = params.get("data", "")
+    model_path = params.get("input_model", "LightGBM_model.txt")
+    if not data_path:
+        log.fatal("No training/prediction data, application quit")
+    if not Path(model_path).exists():
+        log.fatal(f"input model {model_path} does not exist")
+    bst = Booster(model_file=model_path, params=dict(params))
+    loaded = load_text_file(
+        data_path,
+        header=str(params.get("header", "false")).lower() in ("true", "1"),
+        label_column=params.get("label_column", 0),
+        weight_column=params.get("weight_column", ""),
+        group_column=params.get("group_column", ""),
+        ignore_column=params.get("ignore_column", ""),
+    )
+    new_bst = bst.refit(
+        loaded["X"], loaded["label"],
+        decay_rate=float(params.get("refit_decay_rate", 0.9)),
+    )
+    out = params.get("output_model", "LightGBM_model.txt")
+    new_bst.save_model(out)
+    log.info(f"Finished the refit task; new model saved to {out}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     params = parse_kv_args(argv)
@@ -249,8 +300,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         _task_predict(params)
     elif task == "save_binary":
         _task_save_binary(params)
-    elif task in ("convert_model", "refit", "refit_tree"):
-        log.fatal(f"task {task} is not implemented yet")
+    elif task == "convert_model":
+        _task_convert_model(params)
+    elif task in ("refit", "refit_tree"):
+        _task_refit(params)
     else:
         log.fatal(f"Unknown task {task}")
     log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")

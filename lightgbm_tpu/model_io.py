@@ -403,3 +403,91 @@ def load_model_string(model_str: str) -> Tuple[Config, GBDT]:
         trees.append(parse_tree_block(cur))
     gbdt.models = trees
     return cfg, gbdt
+
+
+# ---------------------------------------------------------------------------
+# convert_model: if-else C++ export (reference GBDT::SaveModelToIfElse,
+# src/boosting/gbdt_model_text.cpp:289 + Tree::ToIfElse, src/io/tree.cpp:566).
+# Deviation (deliberate): the reference emits member-function snippets
+# that only compile inside its own build tree; this emits a SELF-CONTAINED
+# translation unit with the same PredictTree{i} functions plus an
+# `extern "C" Predict` entry, so the artifact is usable standalone. The
+# ByMap variants are not emitted.
+
+def _node_if_else(t: Tree, node: int, indent: str) -> str:
+    from .tree import _CAT_MASK, _DEFAULT_LEFT_MASK
+
+    if node < 0:  # leaf
+        return f"{indent}return {float(t.leaf_value[~node])!r};\n"
+    dt = int(t.decision_type[node])
+    f = int(t.split_feature[node])
+    out = [f"{indent}fval = arr[{f}];\n"]
+    if dt & _CAT_MASK:
+        ci = int(t.threshold[node])
+        lo = int(t.cat_boundaries[ci])
+        hi = int(t.cat_boundaries[ci + 1])
+        out.append(
+            f"{indent}ifv = std::isnan(fval) ? -1 : (int)fval;\n"
+            f"{indent}if (ifv >= 0 && ifv < {32 * (hi - lo)} && "
+            f"((cat_threshold[{lo} + ifv / 32] >> (ifv & 31)) & 1)) {{\n"
+        )
+    else:
+        mt = (dt >> 2) & 3
+        dl = bool(dt & _DEFAULT_LEFT_MASK)
+        thr = repr(float(t.threshold[node]))
+        if mt != 2:  # missing != NaN: NaN behaves as 0.0 (tree.h Decision)
+            out.append(f"{indent}if (std::isnan(fval)) fval = 0.0;\n")
+        if mt == 2:
+            cond = (f"std::isnan(fval) || fval <= {thr}" if dl
+                    else f"!std::isnan(fval) && fval <= {thr}")
+        elif mt == 1:
+            z = "std::fabs(fval) <= 1e-35"
+            cond = (f"({z}) || fval <= {thr}" if dl
+                    else f"!({z}) && fval <= {thr}")
+        else:
+            cond = f"fval <= {thr}"
+        out.append(f"{indent}if ({cond}) {{\n")
+    out.append(_node_if_else(t, int(t.left_child[node]), indent + "  "))
+    out.append(f"{indent}}} else {{\n")
+    out.append(_node_if_else(t, int(t.right_child[node]), indent + "  "))
+    out.append(f"{indent}}}\n")
+    return "".join(out)
+
+
+def model_to_if_else(models: List[Tree], num_class: int) -> str:
+    """The full if-else translation unit for a trained model."""
+    parts = [
+        "// generated by lightgbm_tpu convert_model "
+        "(reference: GBDT::SaveModelToIfElse)\n",
+        "#include <cmath>\n#include <cstring>\n\n",
+    ]
+    for i, t in enumerate(models):
+        parts.append(f"double PredictTree{i}(const double* arr) {{\n")
+        if t.num_leaves <= 1:
+            parts.append(f"  return {float(t.leaf_value[0])!r};\n}}\n\n")
+            continue
+        if len(t.cat_threshold):
+            words = ",".join(str(int(w)) for w in t.cat_threshold)
+            parts.append(
+                f"  static const unsigned int cat_threshold[] = {{{words}}};\n"
+            )
+        parts.append("  double fval = 0.0; (void)fval;\n")
+        if len(t.cat_threshold):
+            parts.append("  int ifv = 0; (void)ifv;\n")
+        parts.append(_node_if_else(t, 0, "  "))
+        parts.append("}\n\n")
+
+    n = len(models)
+    ptrs = ", ".join(f"PredictTree{i}" for i in range(n))
+    parts.append(
+        f"double (*PredictTreePtr[])(const double*) = {{ {ptrs} }};\n\n"
+        f"static const int num_tree_per_iteration_ = {num_class};\n"
+        f"static const int num_iteration_for_pred_ = {n // max(num_class, 1)};\n\n"
+        "extern \"C\" void Predict(const double* features, double* output) {\n"
+        "  std::memset(output, 0, sizeof(double) * num_tree_per_iteration_);\n"
+        "  for (int i = 0; i < num_iteration_for_pred_; ++i)\n"
+        "    for (int k = 0; k < num_tree_per_iteration_; ++k)\n"
+        "      output[k] += (*PredictTreePtr[i * num_tree_per_iteration_ + k])(features);\n"
+        "}\n"
+    )
+    return "".join(parts)
