@@ -238,7 +238,10 @@ def _task_convert_model(params: Dict[str, str]) -> None:
     bst = Booster(model_file=model_path)
     out = params.get("convert_model", "gbdt_prediction.cpp")
     Path(out).write_text(
-        model_to_if_else(bst._gbdt.models, bst._gbdt.num_class)
+        model_to_if_else(
+            bst._gbdt.models, bst._gbdt.num_class,
+            average_output=bool(getattr(bst._gbdt, "average_output", False)),
+        )
     )
     log.info(f"Finished converting model to if-else code at {out}")
 
@@ -267,6 +270,7 @@ def _task_refit(params: Dict[str, str]) -> None:
     new_bst = bst.refit(
         loaded["X"], loaded["label"],
         decay_rate=float(params.get("refit_decay_rate", 0.9)),
+        weight=loaded["weight"], group=loaded["group"],
     )
     out = params.get("output_model", "LightGBM_model.txt")
     new_bst.save_model(out)

@@ -454,8 +454,21 @@ def _node_if_else(t: Tree, node: int, indent: str) -> str:
     return "".join(out)
 
 
-def model_to_if_else(models: List[Tree], num_class: int) -> str:
+def model_to_if_else(models: List[Tree], num_class: int,
+                     average_output: bool = False) -> str:
     """The full if-else translation unit for a trained model."""
+    import sys
+
+    if any(t.is_linear for t in models):
+        from . import log
+
+        log.fatal(
+            "convert_model does not support linear trees (leaf_coeff "
+            "terms have no if-else form in the reference either)"
+        )
+    # chain-shaped trees recurse once per level; bound is num_leaves
+    max_leaves = max((t.num_leaves for t in models), default=1)
+    sys.setrecursionlimit(max(sys.getrecursionlimit(), 4 * max_leaves + 1000))
     parts = [
         "// generated by lightgbm_tpu convert_model "
         "(reference: GBDT::SaveModelToIfElse)\n",
@@ -488,6 +501,11 @@ def model_to_if_else(models: List[Tree], num_class: int) -> str:
         "  for (int i = 0; i < num_iteration_for_pred_; ++i)\n"
         "    for (int k = 0; k < num_tree_per_iteration_; ++k)\n"
         "      output[k] += (*PredictTreePtr[i * num_tree_per_iteration_ + k])(features);\n"
-        "}\n"
     )
+    if average_output:  # boosting=rf reports the MEAN of the trees
+        parts.append(
+            "  for (int k = 0; k < num_tree_per_iteration_; ++k)\n"
+            "    output[k] /= num_iteration_for_pred_;\n"
+        )
+    parts.append("}\n")
     return "".join(parts)
