@@ -25,6 +25,15 @@ from .engine import CVBooster, cv, train
 from .log import register_logger
 
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+from .callback import EarlyStopException
+from .dask import DaskLGBMClassifier, DaskLGBMRanker, DaskLGBMRegressor
+from .plotting import (
+    create_tree_digraph,
+    plot_importance,
+    plot_metric,
+    plot_split_value_histogram,
+    plot_tree,
+)
 
 __version__ = "0.1.0"
 
@@ -45,5 +54,14 @@ __all__ = [
     "LGBMClassifier",
     "LGBMRegressor",
     "LGBMRanker",
+    "DaskLGBMClassifier",
+    "DaskLGBMRegressor",
+    "DaskLGBMRanker",
+    "EarlyStopException",
+    "plot_importance",
+    "plot_split_value_histogram",
+    "plot_metric",
+    "plot_tree",
+    "create_tree_digraph",
     "__version__",
 ]

@@ -757,6 +757,37 @@ class Booster:
         )
         return new_booster
 
+    def get_split_value_histogram(
+        self,
+        feature,
+        bins=None,
+        xgboost_style: bool = False,
+    ):
+        """Histogram of the numeric split thresholds the model chose for
+        one feature (reference basic.py:5065). Returns
+        ``numpy.histogram``-style ``(hist, bin_edges)``, or the XGBoost
+        matrix/DataFrame form when ``xgboost_style=True``."""
+        from .plotting import _split_values
+
+        values = _split_values(self, feature)
+        n_unique = len(set(values))
+        if bins is None or (
+            isinstance(bins, int) and xgboost_style and bins > n_unique
+        ):
+            bins = max(n_unique, 1)
+        hist, edges = np.histogram(np.asarray(values, dtype=np.float64),
+                                   bins=bins)
+        if not xgboost_style:
+            return hist, edges
+        keep = hist != 0
+        out = np.column_stack((edges[1:][keep], hist[keep]))
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(out, columns=["SplitValue", "Count"])
+        except ImportError:
+            return out
+
     def feature_importance(self, importance_type: str = "split", iteration=None) -> np.ndarray:
         return self._gbdt.feature_importance(importance_type)
 

@@ -466,29 +466,36 @@ def model_to_if_else(models: List[Tree], num_class: int,
             "convert_model does not support linear trees (leaf_coeff "
             "terms have no if-else form in the reference either)"
         )
-    # chain-shaped trees recurse once per level; bound is num_leaves
+    # chain-shaped trees recurse once per level; bound is num_leaves.
+    # Raise the interpreter limit only for the duration of the walk —
+    # it is process-global state and must not outlive this call.
     max_leaves = max((t.num_leaves for t in models), default=1)
-    sys.setrecursionlimit(max(sys.getrecursionlimit(), 4 * max_leaves + 1000))
+    old_limit = sys.getrecursionlimit()
     parts = [
         "// generated by lightgbm_tpu convert_model "
         "(reference: GBDT::SaveModelToIfElse)\n",
         "#include <cmath>\n#include <cstring>\n\n",
     ]
-    for i, t in enumerate(models):
-        parts.append(f"double PredictTree{i}(const double* arr) {{\n")
-        if t.num_leaves <= 1:
-            parts.append(f"  return {float(t.leaf_value[0])!r};\n}}\n\n")
-            continue
-        if len(t.cat_threshold):
-            words = ",".join(str(int(w)) for w in t.cat_threshold)
-            parts.append(
-                f"  static const unsigned int cat_threshold[] = {{{words}}};\n"
-            )
-        parts.append("  double fval = 0.0; (void)fval;\n")
-        if len(t.cat_threshold):
-            parts.append("  int ifv = 0; (void)ifv;\n")
-        parts.append(_node_if_else(t, 0, "  "))
-        parts.append("}\n\n")
+    try:
+        sys.setrecursionlimit(max(old_limit, 4 * max_leaves + 1000))
+        for i, t in enumerate(models):
+            parts.append(f"double PredictTree{i}(const double* arr) {{\n")
+            if t.num_leaves <= 1:
+                parts.append(f"  return {float(t.leaf_value[0])!r};\n}}\n\n")
+                continue
+            if len(t.cat_threshold):
+                words = ",".join(str(int(w)) for w in t.cat_threshold)
+                parts.append(
+                    f"  static const unsigned int cat_threshold[] = "
+                    f"{{{words}}};\n"
+                )
+            parts.append("  double fval = 0.0; (void)fval;\n")
+            if len(t.cat_threshold):
+                parts.append("  int ifv = 0; (void)ifv;\n")
+            parts.append(_node_if_else(t, 0, "  "))
+            parts.append("}\n\n")
+    finally:
+        sys.setrecursionlimit(old_limit)
 
     n = len(models)
     ptrs = ", ".join(f"PredictTree{i}" for i in range(n))

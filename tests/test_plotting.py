@@ -1,0 +1,133 @@
+"""Plotting surface: plot_importance / plot_split_value_histogram /
+plot_metric / plot_tree / create_tree_digraph (reference
+python-package/lightgbm/plotting.py; tests modeled on
+tests/python_package_test/test_plotting.py)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rs = np.random.RandomState(7)
+    X = rs.randn(400, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rs.randn(400) > 0).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    evals = {}
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "metric": ["auc", "binary_logloss"], "verbosity": -1},
+        ds,
+        num_boost_round=12,
+        valid_sets=[ds],
+        valid_names=["train"],
+        callbacks=[lgb.record_evaluation(evals)],
+    )
+    return bst, evals, X, y
+
+
+def test_plot_importance(trained):
+    bst, _, _, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax.get_title() == "Feature importance"
+    assert len(ax.patches) >= 1
+    ax2 = lgb.plot_importance(bst, importance_type="gain",
+                              max_num_features=3, title="t", xlabel="x",
+                              ylabel="y", grid=False)
+    assert len(ax2.patches) <= 3
+    assert ax2.get_title() == "t"
+
+
+def test_plot_importance_sklearn(trained):
+    _, _, X, y = trained
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7, verbosity=-1)
+    clf.fit(X, y)
+    ax = lgb.plot_importance(clf)  # importance_type='auto' -> estimator's
+    assert len(ax.patches) >= 1
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _, _, _ = trained
+    imp = bst.feature_importance("split")
+    feat = int(np.argmax(imp))
+    ax = lgb.plot_split_value_histogram(bst, feat)
+    assert "index" in ax.get_title()
+    name = bst.feature_name()[feat]
+    ax2 = lgb.plot_split_value_histogram(bst, name, bins=5)
+    assert "name" in ax2.get_title()
+    unused = int(np.argmin(imp))
+    if imp[unused] == 0:
+        with pytest.raises(ValueError):
+            lgb.plot_split_value_histogram(bst, unused)
+
+
+def test_get_split_value_histogram(trained):
+    bst, _, _, _ = trained
+    feat = int(np.argmax(bst.feature_importance("split")))
+    hist, edges = bst.get_split_value_histogram(feat)
+    assert hist.sum() >= 1
+    assert len(edges) == len(hist) + 1
+    df = bst.get_split_value_histogram(feat, bins=3, xgboost_style=True)
+    assert list(df.columns) == ["SplitValue", "Count"]
+    assert (df["Count"] > 0).all()
+
+
+def test_plot_metric(trained):
+    bst, evals, X, y = trained
+    ax = lgb.plot_metric(evals)
+    assert ax.get_xlabel() == "Iterations"
+    ax2 = lgb.plot_metric(evals, metric="auc", dataset_names=["train"])
+    assert ax2.get_ylabel() == "auc"
+    with pytest.raises(TypeError):
+        lgb.plot_metric(bst)
+    clf = lgb.LGBMClassifier(n_estimators=4, num_leaves=7, verbosity=-1)
+    clf.fit(X, y, eval_set=[(X, y)])
+    ax3 = lgb.plot_metric(clf)
+    assert ax3 is not None
+
+
+def test_create_tree_digraph(trained):
+    bst, _, X, _ = trained
+    g = lgb.create_tree_digraph(
+        bst, tree_index=1,
+        show_info=["split_gain", "internal_count", "leaf_count",
+                   "data_percentage"],
+    )
+    src = g.source
+    assert "digraph" in src
+    assert "<=" in src
+    assert "leaf" in src
+    assert "count:" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(bst, tree_index=10_000)
+    # example_case highlights the decision path
+    g2 = lgb.create_tree_digraph(bst, example_case=X[:1])
+    assert "blue" in g2.source
+
+
+def test_dot_standin_matches_graphviz_surface():
+    from lightgbm_tpu.plotting import _DotStandin
+
+    d = _DotStandin("T", graph_attr={"rankdir": "LR"})
+    d.node("n0", "root <= 1.5", shape="rectangle")
+    d.node("n1", "leaf 0: 0.3")
+    d.edge("n0", "n1", label="yes")
+    src = d.source
+    assert src.startswith("digraph T {") and src.endswith("}")
+    assert 'n0 -> n1 [label="yes"]' in src
+
+
+def test_plot_tree(trained):
+    bst, _, X, _ = trained
+    ax = lgb.plot_tree(bst, tree_index=0,
+                       show_info=["internal_count", "leaf_count"])
+    assert len(ax.texts) >= 3  # at least root + two children drawn
+    ax2 = lgb.plot_tree(bst, orientation="vertical", example_case=X[:1])
+    assert ax2 is not None
+    with pytest.raises(IndexError):
+        lgb.plot_tree(bst, tree_index=9_999)
