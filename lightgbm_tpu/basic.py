@@ -238,6 +238,62 @@ class Dataset:
             if self.free_raw_data:
                 self.data = None
             return self
+        if isinstance(self.data, (str, Path)):
+            # file-path input (reference Dataset accepts text or binary
+            # data files directly; DatasetLoader::LoadFromFile): .bin
+            # caches load pre-binned, text files parse CSV/TSV/LibSVM
+            from .config import resolve_alias as _ra
+            from .parsers import is_binary_file, load_binary, load_text_file
+
+            path = str(self.data)
+            fp = {_ra(k): v for k, v in self.params.items()}
+            with _gt.scope("dataset construct (file)"):
+                if is_binary_file(path):
+                    self._binned = load_binary(path)
+                    md = self._binned.metadata
+                    if self.label is not None:
+                        md.label = np.asarray(self.label, np.float32)
+                    if self.weight is not None:
+                        md.weight = np.asarray(self.weight, np.float32)
+                    if self.group is not None:
+                        md.group = np.asarray(self.group, np.int64)
+                    if self.init_score is not None:
+                        md.init_score = np.asarray(self.init_score,
+                                                   np.float64)
+                    if self.position is not None:
+                        md.position = np.asarray(self.position, np.int32)
+                    if self.free_raw_data:
+                        self.data = None
+                    return self
+                loaded = load_text_file(
+                    path,
+                    header=str(fp.get("header", "false")).lower()
+                    in ("true", "1"),
+                    label_column=fp.get("label_column", 0),
+                    weight_column=fp.get("weight_column", ""),
+                    group_column=fp.get("group_column", ""),
+                    ignore_column=fp.get("ignore_column", ""),
+                    categorical_feature=fp.get("categorical_feature", ""),
+                )
+                self.data = loaded["X"]
+                if self.label is None and loaded["label"] is not None:
+                    self.label = np.asarray(loaded["label"])
+                if self.weight is None and loaded["weight"] is not None:
+                    self.weight = np.asarray(loaded["weight"])
+                if self.group is None and loaded["group"] is not None:
+                    self.group = np.asarray(loaded["group"])
+                if (self.init_score is None
+                        and loaded.get("init_score") is not None):
+                    self.init_score = np.asarray(loaded["init_score"])
+                if (self.feature_name == "auto"
+                        and loaded["feature_names"]):
+                    self.feature_name = loaded["feature_names"]
+                if (self.categorical_feature == "auto"
+                        and loaded["categorical_feature"]):
+                    self.categorical_feature = loaded[
+                        "categorical_feature"
+                    ]
+            # fall through to the numpy path below with the parsed matrix
         cfg0 = Config(self.params)
         _sparse_names = (
             [str(n) for n in self.feature_name]
@@ -357,6 +413,15 @@ class Dataset:
             )
         return self
 
+    def set_position(self, position) -> "Dataset":
+        self.position = _to_1d(position)
+        if self._binned is not None:
+            self._binned.metadata.position = (
+                np.asarray(self.position, dtype=np.int32)
+                if position is not None else None
+            )
+        return self
+
     def get_label(self):
         return self.label
 
@@ -369,14 +434,174 @@ class Dataset:
     def get_init_score(self):
         return self.init_score
 
+    def get_position(self):
+        return self.position
+
+    _FIELDS = ("label", "weight", "group", "init_score", "position")
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """Generic metadata setter (LGBM_DatasetSetField;
+        reference basic.py Dataset.set_field)."""
+        if field_name not in self._FIELDS:
+            raise KeyError(f"unknown field {field_name!r}")
+        return getattr(self, f"set_{field_name}")(data)
+
+    def get_field(self, field_name: str):
+        """Generic metadata getter (LGBM_DatasetGetField)."""
+        if field_name not in self._FIELDS:
+            raise KeyError(f"unknown field {field_name!r}")
+        return getattr(self, f"get_{field_name}")()
+
+    def get_data(self):
+        """The raw data this Dataset was built from (reference
+        basic.py Dataset.get_data). Unavailable once raw data was
+        freed (free_raw_data=True after construct)."""
+        if self.data is None:
+            raise LightGBMError(
+                "Cannot call get_data after freeing raw data; "
+                "set free_raw_data=False when constructing the Dataset"
+            )
+        return self.data
+
+    def get_params(self) -> Dict[str, Any]:
+        """The Dataset-relevant parameters this Dataset carries
+        (reference basic.py Dataset.get_params)."""
+        from .config import DATASET_PARAMS, resolve_alias
+
+        return {
+            k: v for k, v in self.params.items()
+            if resolve_alias(k) in DATASET_PARAMS
+        }
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Bin this Dataset with another Dataset's bin mappers
+        (reference basic.py Dataset.set_reference)."""
+        if self._binned is not None and self.reference is not reference:
+            raise LightGBMError(
+                "Cannot set reference after the Dataset was constructed; "
+                "pass reference= at creation"
+            )
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """Set of Datasets reachable through .reference links
+        (reference basic.py Dataset.get_ref_chain)."""
+        head = self
+        chain = set()
+        while len(chain) < ref_limit:
+            if isinstance(head, Dataset):
+                chain.add(head)
+                if head.reference is not None:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return chain
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        """Set feature names; after construction renames in place
+        (reference basic.py Dataset.set_feature_name)."""
+        self.feature_name = feature_name
+        if self._binned is not None and feature_name != "auto":
+            names = list(feature_name)
+            if len(names) != self._binned.num_total_features:
+                raise LightGBMError(
+                    f"Length of feature names {len(names)} does not match "
+                    f"number of features {self._binned.num_total_features}"
+                )
+            self._binned.feature_names = names
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Set categorical features; binding happens at construct
+        (reference basic.py Dataset.set_categorical_feature)."""
+        if self.categorical_feature == categorical_feature:
+            return self
+        if self._binned is not None:
+            raise LightGBMError(
+                "Cannot set categorical feature after the Dataset was "
+                "constructed; set it at creation"
+            )
+        self.categorical_feature = categorical_feature
+        return self
+
+    def feature_num_bin(self, feature: Union[int, str]) -> int:
+        """Number of bins for a feature (LGBM_DatasetGetFeatureNumBin)."""
+        self.construct()
+        if isinstance(feature, str):
+            feature = self._binned.feature_names.index(feature)
+        return int(self._binned.mappers[feature].num_bin)
+
+    def save_binary(self, filename: Union[str, Path]) -> "Dataset":
+        """Persist the binned form to a fast-reload binary file
+        (Dataset::SaveBinaryFile, dataset.h:700; reload by passing the
+        path as Dataset(data=...) — parsers.py binary cache format)."""
+        from .parsers import save_binary as _save
+
+        self.construct()
+        _save(self._binned, str(filename))
+        return self
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Horizontally stack another Dataset's features into this one
+        (reference basic.py Dataset.add_features_from /
+        LGBM_DatasetAddFeaturesFrom). TPU deviation: the reference
+        splices the other dataset's FeatureGroups into this one's bin
+        structure; here both raw matrices are concatenated and binning
+        re-runs at next construct — requires raw data on both sides
+        (free_raw_data=False)."""
+        if self.data is None or other.data is None:
+            raise LightGBMError(
+                "add_features_from requires raw data on both Datasets "
+                "(free_raw_data=False)"
+            )
+        a, a_names = _to_2d_numpy(self.data)
+        b, b_names = _to_2d_numpy(other.data)
+        if a.shape[0] != b.shape[0]:
+            raise LightGBMError(
+                f"Cannot add features from a Dataset with {b.shape[0]} "
+                f"rows to one with {a.shape[0]} rows"
+            )
+        self.data = np.concatenate([a, b], axis=1)
+        if (isinstance(self.feature_name, list)
+                and isinstance(other.feature_name, list)):
+            self.feature_name = list(self.feature_name) + list(
+                other.feature_name
+            )
+        else:
+            self.feature_name = "auto"
+        cf_a = self.categorical_feature
+        cf_b = other.categorical_feature
+        if cf_a != "auto" or cf_b != "auto":
+            # string names survive the merge (feature-name lists were
+            # concatenated above); integer indices from `other` shift by
+            # this dataset's original width
+            merged = [] if cf_a == "auto" else list(cf_a)
+            if cf_b != "auto":
+                merged += [
+                    c if isinstance(c, str) else c + a.shape[1]
+                    for c in cf_b
+                ]
+            self.categorical_feature = merged
+        self._binned = None  # re-bin with the widened matrix
+        return self
+
     def num_data(self) -> int:
         if self._binned is not None:
+            return self._binned.num_data
+        if isinstance(self.data, (str, Path)):
+            self.construct()  # file input: shape is unknown until parsed
             return self._binned.num_data
         arr, _ = _to_2d_numpy(self.data)
         return arr.shape[0]
 
     def num_feature(self) -> int:
         if self._binned is not None:
+            return self._binned.num_total_features
+        if isinstance(self.data, (str, Path)):
+            self.construct()
             return self._binned.num_total_features
         arr, _ = _to_2d_numpy(self.data)
         return arr.shape[1]
@@ -803,6 +1028,170 @@ class Booster:
 
     def free_dataset(self) -> "Booster":
         self.train_set = None
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Name used for the training set in eval output (reference
+        basic.py Booster.set_train_data_name)."""
+        self._train_data_name = name
+        return self
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Load a model from its text-format string in place
+        (reference basic.py Booster.model_from_string)."""
+        from .model_io import load_model_string
+
+        self.config, self._gbdt = load_model_string(model_str)
+        self.train_set = None
+        self._valid_sets = []
+        self._name_valid_sets = []
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Output value of one leaf (LGBM_BoosterGetLeafValue)."""
+        return float(self._gbdt.models[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """Overwrite one leaf's output value (LGBM_BoosterSetLeafValue;
+        Tree::SetLeafOutput). Updates the device-resident copy used by
+        fused validation scoring as well as the host tree; like the
+        reference, already-accumulated train/valid scores are not
+        retroactively adjusted."""
+        t = self._gbdt.models[tree_id]
+        t.leaf_value[leaf_id] = float(value)
+        if tree_id < len(self._gbdt.device_trees):
+            arrays, aux = self._gbdt.device_trees[tree_id]
+            if arrays is not None:
+                arrays = arrays._replace(
+                    leaf_value=arrays.leaf_value.at[leaf_id].set(
+                        float(value)
+                    )
+                )
+                self._gbdt.device_trees[tree_id] = (arrays, aux)
+        return self
+
+    def lower_bound(self) -> float:
+        """Lower bound of the raw score over all possible inputs
+        (LGBM_BoosterGetLowerBoundValue: sum of per-tree minima)."""
+        return float(sum(
+            float(np.min(t.leaf_value[: t.num_leaves]))
+            for t in self._gbdt.models
+        ))
+
+    def upper_bound(self) -> float:
+        """Upper bound of the raw score (LGBM_BoosterGetUpperBoundValue)."""
+        return float(sum(
+            float(np.max(t.leaf_value[: t.num_leaves]))
+            for t in self._gbdt.models
+        ))
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute the tree order in [start, end) iterations
+        (LGBM_BoosterShuffleModels; predictions are order-invariant)."""
+        K = self.num_model_per_iteration()
+        n_iter = self._gbdt.num_trees() // K
+        end = n_iter if end_iteration < 0 else min(end_iteration, n_iter)
+        idx = np.arange(start_iteration, end)
+        np.random.shuffle(idx)
+        order = np.concatenate([
+            np.arange(start_iteration),
+            idx,
+            np.arange(end, n_iter),
+        ])
+        models, dev = self._gbdt.models, self._gbdt.device_trees
+        self._gbdt.models = [
+            models[i * K + k] for i in order for k in range(K)
+        ]
+        if len(dev) == len(models):
+            self._gbdt.device_trees = [
+                dev[i * K + k] for i in order for k in range(K)
+            ]
+        return self
+
+    def trees_to_dataframe(self):
+        """All trees flattened to one pandas DataFrame, one row per
+        node/leaf (reference basic.py Booster.trees_to_dataframe —
+        same column set)."""
+        import pandas as pd
+
+        if self._gbdt.num_trees() == 0:
+            raise LightGBMError(
+                "There are no trees in this Booster and thus nothing "
+                "to parse"
+            )
+
+        rows: List[Dict[str, Any]] = []
+
+        def node_ix(tree_index: int, node: Dict[str, Any]) -> str:
+            if "split_index" in node:
+                return f"{tree_index}-S{node['split_index']}"
+            return f"{tree_index}-L{node.get('leaf_index', 0)}"
+
+        model = self.dump_model()
+        for t in model["tree_info"]:
+            tree_index = t["tree_index"]
+            # explicit preorder stack: chain-shaped deep trees must not
+            # hit the interpreter recursion limit
+            stack = [(t["tree_structure"], 1, None)]
+            while stack:
+                node, depth, parent = stack.pop()
+                ix = node_ix(tree_index, node)
+                is_split = "split_index" in node
+                left = node.get("left_child")
+                right = node.get("right_child")
+                rows.append({
+                    "tree_index": tree_index,
+                    "node_depth": depth,
+                    "node_index": ix,
+                    "left_child": (
+                        node_ix(tree_index, left) if left else None
+                    ),
+                    "right_child": (
+                        node_ix(tree_index, right) if right else None
+                    ),
+                    "parent_index": parent,
+                    "split_feature": (
+                        self._feature_display_name(node["split_feature"])
+                        if is_split else None
+                    ),
+                    "split_gain": node.get("split_gain"),
+                    "threshold": node.get("threshold"),
+                    "decision_type": node.get("decision_type"),
+                    "missing_direction": (
+                        ("left" if node.get("default_left") else "right")
+                        if is_split else None
+                    ),
+                    "missing_type": node.get("missing_type"),
+                    "value": node.get("internal_value",
+                                      node.get("leaf_value")),
+                    "weight": node.get("internal_weight",
+                                       node.get("leaf_weight")),
+                    "count": node.get("internal_count",
+                                      node.get("leaf_count")),
+                })
+                if is_split:
+                    stack.append((right, depth + 1, ix))
+                    stack.append((left, depth + 1, ix))
+        return pd.DataFrame(rows)
+
+    def _feature_display_name(self, fidx: int) -> str:
+        names = self.feature_name()
+        return names[fidx] if fidx < len(names) else f"Column_{fidx}"
+
+    def set_network(
+        self,
+        machines: Any,
+        local_listen_port: int = 12400,
+        listen_time_out: int = 120,
+        num_machines: int = 1,
+    ) -> "Booster":
+        """Join a multi-host cluster from an existing Booster (reference
+        basic.py Booster.set_network; module-level set_network applies)."""
+        set_network(machines, local_listen_port, listen_time_out,
+                    num_machines)
+        self._network = True
         return self
 
     def free_network(self) -> "Booster":

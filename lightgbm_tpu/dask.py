@@ -109,12 +109,19 @@ class _DaskMixin:
             kwargs["eval_set"] = [
                 (_materialize(a), _materialize(b)) for a, b in es
             ]
-        for key in ("sample_weight", "init_score", "group",
-                    "eval_sample_weight", "eval_init_score", "eval_group"):
-            if kwargs.get(key) is not None and not isinstance(
-                kwargs[key], (list, tuple)
-            ):
+        for key in ("sample_weight", "init_score", "group"):
+            if kwargs.get(key) is not None:
                 kwargs[key] = _materialize(kwargs[key])
+        for key in ("eval_sample_weight", "eval_init_score", "eval_group"):
+            val = kwargs.get(key)
+            if val is None:
+                continue
+            # standard form: one entry per eval set — materialize each;
+            # a bare collection is materialized whole
+            if isinstance(val, (list, tuple)):
+                kwargs[key] = [_materialize(v) for v in val]
+            else:
+                kwargs[key] = _materialize(val)
         return kwargs
 
     def fit(self, X, y, **kwargs):  # noqa: D102 - see class docstring

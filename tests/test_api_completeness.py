@@ -1,0 +1,226 @@
+"""Reference-parity convenience APIs added for user-switch completeness:
+Dataset get/set_field, get_data, get_params, set_reference/get_ref_chain,
+set_feature_name/set_categorical_feature, feature_num_bin, save_binary,
+add_features_from; Booster get/set_leaf_output, lower/upper_bound,
+model_from_string, shuffle_models, trees_to_dataframe,
+set_train_data_name (reference basic.py surface)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.log import LightGBMError
+
+
+@pytest.fixture(scope="module")
+def data():
+    rs = np.random.RandomState(11)
+    X = rs.randn(400, 5)
+    y = (X[:, 0] + 0.3 * rs.randn(400) > 0).astype(float)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster(data):
+    X, y = data
+    return lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y, free_raw_data=False),
+        num_boost_round=6,
+    )
+
+
+def test_dataset_fields(data):
+    X, y = data
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    ds.set_field("weight", np.ones(400))
+    assert ds.get_field("weight").shape == (400,)
+    ds.set_field("position", np.zeros(400, np.int32))
+    assert ds.get_field("position").shape == (400,)
+    with pytest.raises(KeyError):
+        ds.set_field("nope", y)
+    assert ds.get_data().shape == (400, 5)
+    ds2 = lgb.Dataset(X, label=y)  # free_raw_data=True default
+    ds2.construct()
+    ds2.data = None  # what free-after-construct leaves behind
+    with pytest.raises(LightGBMError):
+        ds2.get_data()
+
+
+def test_dataset_params_and_bins(data):
+    X, y = data
+    ds = lgb.Dataset(X, label=y,
+                     params={"max_bin": 63, "learning_rate": 0.5})
+    assert ds.get_params() == {"max_bin": 63}  # non-dataset params dropped
+    ds.construct()
+    assert 2 <= ds.feature_num_bin(0) <= 64
+
+
+def test_ref_chain_and_reference(data):
+    X, y = data
+    ds = lgb.Dataset(X, label=y)
+    vs = lgb.Dataset(X[:100], label=y[:100], reference=ds)
+    chain = vs.get_ref_chain()
+    assert ds in chain and vs in chain
+    ds.construct()
+    with pytest.raises(LightGBMError):
+        ds.set_reference(vs)  # constructed with a different reference
+
+
+def test_set_names_and_categorical(data):
+    X, y = data
+    ds = lgb.Dataset(X, label=y)
+    ds.set_feature_name([f"f{i}" for i in range(5)])
+    ds.construct()
+    assert ds.get_feature_name() == [f"f{i}" for i in range(5)]
+    ds.set_feature_name([f"g{i}" for i in range(5)])  # rename in place
+    assert ds.get_feature_name() == [f"g{i}" for i in range(5)]
+    with pytest.raises(LightGBMError):
+        ds.set_feature_name(["too", "short"])
+    with pytest.raises(LightGBMError):
+        ds.set_categorical_feature([0])  # after construct
+    ds2 = lgb.Dataset(X, label=y)
+    ds2.set_categorical_feature([1])
+    assert ds2.categorical_feature == [1]
+
+
+def test_save_binary_roundtrip(tmp_path, data):
+    X, y = data
+    ds = lgb.Dataset(X, label=y)
+    path = tmp_path / "train.bin"
+    ds.save_binary(path)
+    ds2 = lgb.Dataset(str(path))
+    ds2.construct()
+    assert ds2.num_data() == 400
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, ds2, num_boost_round=2)
+    assert bst.num_trees() == 2
+
+
+def test_dataset_from_text_file(tmp_path, data):
+    X, y = data
+    path = tmp_path / "train.csv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+    ds = lgb.Dataset(str(path))
+    ds.construct()
+    assert ds.num_data() == 400 and ds.num_feature() == 5
+    assert ds.get_label().shape == (400,)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, ds, num_boost_round=2)
+    assert bst.num_trees() == 2
+
+
+def test_dataset_from_text_file_params(tmp_path, data):
+    """header= and label_column= params reach the parser; the .init
+    sidecar loads as init_score (code-review r4 findings)."""
+    X, y = data
+    path = tmp_path / "tr.csv"
+    with open(path, "w") as f:
+        f.write("target," + ",".join(f"c{i}" for i in range(5)) + "\n")
+        np.savetxt(f, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+    with open(str(path) + ".init", "w") as f:
+        f.write("0.25\n" * 400)
+    ds = lgb.Dataset(str(path), params={"header": True})
+    ds.construct()
+    assert ds.num_data() == 400 and ds.num_feature() == 5
+    assert ds.get_init_score() is not None
+    assert float(np.unique(ds.get_init_score())[0]) == pytest.approx(0.25)
+
+
+def test_num_data_on_unconstructed_file(tmp_path, data):
+    X, y = data
+    path = tmp_path / "t.csv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.5f")
+    ds = lgb.Dataset(str(path))
+    assert ds.num_data() == 400  # constructs on demand, no IndexError
+    assert lgb.Dataset(str(path)).num_feature() == 5
+
+
+def test_add_features_from_string_categoricals(data):
+    X, y = data
+    a = lgb.Dataset(X, label=y, free_raw_data=False,
+                    feature_name=[f"a{i}" for i in range(5)],
+                    categorical_feature=["a2"])
+    b = lgb.Dataset(X[:, :2], free_raw_data=False,
+                    feature_name=["b0", "b1"], categorical_feature=["b1", 0])
+    a.add_features_from(b)
+    assert a.categorical_feature == ["a2", "b1", 5]  # names kept, ints shifted
+
+
+def test_add_features_from(data):
+    X, y = data
+    a = lgb.Dataset(X, label=y, free_raw_data=False,
+                    feature_name=[f"a{i}" for i in range(5)])
+    b = lgb.Dataset(X[:, :2] * 2.0, free_raw_data=False,
+                    feature_name=["b0", "b1"], categorical_feature=[])
+    a.add_features_from(b)
+    a.construct()
+    assert a.num_feature() == 7
+    assert a.get_feature_name()[:5] == [f"a{i}" for i in range(5)]
+    mismatched = lgb.Dataset(X[:10], free_raw_data=False)
+    with pytest.raises(LightGBMError):
+        a.add_features_from(mismatched)
+
+
+def test_leaf_output_roundtrip(booster, data):
+    X, _ = data
+    p0 = booster.predict(X[:20], raw_score=True)
+    v = booster.get_leaf_output(0, 0)
+    booster.set_leaf_output(0, 0, v + 2.0)
+    assert booster.get_leaf_output(0, 0) == pytest.approx(v + 2.0)
+    booster.set_leaf_output(0, 0, v)
+    np.testing.assert_allclose(
+        booster.predict(X[:20], raw_score=True), p0, atol=1e-12
+    )
+
+
+def test_bounds_contain_predictions(booster, data):
+    X, _ = data
+    raw = booster.predict(X, raw_score=True)
+    assert booster.lower_bound() <= raw.min()
+    assert booster.upper_bound() >= raw.max()
+
+
+def test_shuffle_models_invariant(booster, data):
+    X, _ = data
+    p0 = booster.predict(X[:50], raw_score=True)
+    np.random.seed(3)
+    booster.shuffle_models()
+    np.testing.assert_allclose(
+        booster.predict(X[:50], raw_score=True), p0, atol=1e-10
+    )
+    booster.shuffle_models(start_iteration=2, end_iteration=5)
+    np.testing.assert_allclose(
+        booster.predict(X[:50], raw_score=True), p0, atol=1e-10
+    )
+
+
+def test_trees_to_dataframe(booster):
+    df = booster.trees_to_dataframe()
+    expected = {
+        "tree_index", "node_depth", "node_index", "left_child",
+        "right_child", "parent_index", "split_feature", "split_gain",
+        "threshold", "decision_type", "missing_direction", "missing_type",
+        "value", "weight", "count",
+    }
+    assert expected <= set(df.columns)
+    assert df["tree_index"].nunique() == booster.num_trees()
+    # splits have children; leaves have values
+    splits = df[df["split_feature"].notna()]
+    assert (splits["left_child"].notna()).all()
+    leaves = df[df["split_feature"].isna()]
+    assert (leaves["value"].notna()).all()
+    # every parent_index refers to an existing node
+    known = set(df["node_index"])
+    parents = set(df["parent_index"].dropna())
+    assert parents <= known
+
+
+def test_model_from_string_and_train_name(booster, data):
+    X, _ = data
+    s = booster.model_to_string()
+    b = lgb.Booster(model_str=s)
+    b.model_from_string(s)
+    np.testing.assert_allclose(b.predict(X[:20]), booster.predict(X[:20]))
+    assert booster.set_train_data_name("tr2") is booster
+    assert booster._train_data_name == "tr2"

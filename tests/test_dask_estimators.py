@@ -73,8 +73,11 @@ def test_client_property(xy):
 def test_eval_set_materialized(xy):
     X, y = xy
     clf = lgb.DaskLGBMClassifier(n_estimators=4, num_leaves=7, verbosity=-1)
+    ew = _FakeCollection(np.ones(64))
     clf.fit(
         _FakeCollection(X), _FakeCollection(y),
         eval_set=[(_FakeCollection(X[:64]), _FakeCollection(y[:64]))],
+        eval_sample_weight=[ew],
     )
     assert clf.evals_result_
+    assert ew.computed == 1  # per-eval-set list entries materialize too
