@@ -120,7 +120,8 @@ def _final_json():
         "stage": _STATE.get("stage", "unknown"),
         "last_tpu_verified": LAST_TPU_VERIFIED,
     }
-    for k in ("auc_valid", "trees_done", "warmup_s", "growth_mode"):
+    for k in ("auc_valid", "trees_done", "warmup_s", "growth_mode",
+              "total_trees_per_sec"):
         if k in _STATE:
             out[k] = _STATE[k]
     return out
@@ -280,10 +281,26 @@ def main() -> None:
     sys.stderr.write(f"[bench] warmup ({warmup} trees) in {compile_s:.1f}s\n")
     save_partial(stage="timed", warmup_s=round(compile_s, 2))
 
+    # Callbacks replay at fused-loop chunk boundaries (engine chunk =
+    # _check_every = 50), so consecutive callback wall times within one
+    # chunk are compressed; chunk-boundary deltas are REAL sync points.
+    # Steady-state trees/s = trees between the first and last boundary
+    # over the wall time between them — this excludes the one-time jit
+    # trace+lowering the first dispatch pays (the XLA compile itself is
+    # served by the persistent cache). Both numbers are reported;
+    # `value` is steady-state when >= 2 boundaries exist.
+    marks = []  # (trees_done, wall_time) at observed callback bursts
+
     def progress(env):
         done = env.iteration + 1
+        now = time.time()
+        if not marks or done > marks[-1][0]:
+            if marks and now - marks[-1][1] < 0.05:
+                marks[-1] = (done, now)  # same replay burst; keep last
+            else:
+                marks.append((done, now))
         if done % 10 == 0 or done == trees or done <= 3:
-            dt = time.time() - t0
+            dt = now - t0
             tps = done / dt if dt > 0 else 0.0
             sys.stderr.write(f"[bench] {done}/{trees} trees, {tps:.3f} trees/s\n")
             save_partial(trees_done=done, elapsed_s=round(dt, 2),
@@ -295,8 +312,29 @@ def main() -> None:
                      callbacks=[progress])
     dt = time.time() - t0
 
-    save_partial(stage="scoring", trees_per_sec=round(trees / dt, 4),
-                 trees_done=trees)
+    total_tps = trees / dt
+    steady = None
+    if len(marks) >= 2:
+        # collapse replay bursts: marks within 1 s of the previous mark
+        # belong to the same chunk-boundary replay (a slow save_partial
+        # can split a burst past the 50 ms window above); the LAST mark
+        # of each burst is the real sync point
+        bursts = [marks[0]]
+        for d, w in marks[1:]:
+            if w - bursts[-1][1] < 1.0:
+                bursts[-1] = (d, w)
+            else:
+                bursts.append((d, w))
+        if len(bursts) >= 2:
+            (d0, w0), (d1, w1) = bursts[0], bursts[-1]
+            if d1 > d0 and w1 > w0:
+                steady = (d1 - d0) / (w1 - w0)
+    save_partial(
+        stage="scoring",
+        trees_per_sec=round(steady if steady else total_tps, 4),
+        total_trees_per_sec=round(total_tps, 4),
+        trees_done=trees,
+    )
     try:
         from sklearn.metrics import roc_auc_score
 

@@ -447,6 +447,10 @@ class GBDT:
             cegb=use_cegb,
             n_groups=n_groups,
             n_forced=n_forced,
+            has_cat=any(
+                m.bin_type == BinType.CATEGORICAL
+                for m in train_set.used_mappers()
+            ),
         )
         self.params = make_split_params(config)
         self.train = _ScoreSet(

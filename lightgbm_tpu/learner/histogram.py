@@ -223,11 +223,12 @@ def hist_nat_slots(
     its per-leaf row indices."""
     F, N = bins_fm.shape
     nat_ch = 3 if quant else NAT_CH
-    # VMEM guard: the kernel holds out + scratch accumulators of
-    # (chunk*nat_ch, F*B) f32 each; chunk the slot axis so both fit the
-    # ~16MB/core budget (wide feature sets would otherwise fail the
-    # Mosaic compile on the default-on TPU path)
-    per_slot = nat_ch * F * num_bins * 4 * 2
+    # VMEM guard: the kernel accumulates into its grid-constant output
+    # block of (chunk*nat_ch, F*B) f32; chunk the slot axis so it fits
+    # the ~16MB/core budget alongside the double-buffered input tiles
+    # (wide feature sets would otherwise fail the Mosaic compile on the
+    # default-on TPU path)
+    per_slot = nat_ch * F * num_bins * 4
     s_max = max(1, (12 * 2 ** 20) // max(per_slot, 1))
     if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
             and per_slot <= 12 * 2 ** 20):

@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from .histogram import CH, HIST_BLK, NAT_CH
 
 
-def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref, acc_ref,
+def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref,
                 *, F: int, B: int, blk: int, S: int, nat_ch: int):
     """Slot-packed natural-order histogram: rows carry a slot id; the
     weight matrix W packs (slot x channel) onto the MXU's M axis —
@@ -45,12 +45,17 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref, acc_ref,
     (blk, B) matmul per feature accumulates ALL slots' histograms. With
     S*nat_ch ~ 125 of the MXU's 128 M rows useful, up to 25 slots (42
     under quantized training's 3 integer channels) cost the wall time
-    the single-leaf kernel spends on 8 rows."""
+    the single-leaf kernel spends on 8 rows.
+
+    The output block is grid-constant (index_map (0, 0)) so it stays
+    VMEM-resident across grid steps — accumulate into it directly
+    instead of a scratch copy (a separate scratch doubled the scoped
+    VMEM footprint and capped S at ~25 of the 16 MB budget)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        out_ref[...] = jnp.zeros_like(out_ref)
 
     slot = slot_ref[0, :]  # (blk,) int32
     gh = gh_ref[...]  # (CH, blk) f32; rows 0..nat_ch-1 are live
@@ -63,13 +68,9 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref, acc_ref,
     iota_b = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
     for f in range(F):
         onehot = (bt[:, f : f + 1] == iota_b).astype(jnp.bfloat16)  # (blk, B)
-        acc_ref[:, f * B : (f + 1) * B] += jnp.dot(
+        out_ref[:, f * B : (f + 1) * B] += jnp.dot(
             W, onehot, preferred_element_type=jnp.float32
         )
-
-    @pl.when(i == pl.num_programs(0) - 1)
-    def _flush():
-        out_ref[...] = acc_ref[...]
 
 
 @functools.partial(
@@ -105,31 +106,26 @@ def hist_nat_tpu(
             (S * nat_ch, F * B), lambda i: (0, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((S * nat_ch, F * B), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((S * nat_ch, F * B), jnp.float32)],
         interpret=interpret,
     )(bins_fm, gh8, slot.reshape(1, N))
     return out
 
 
-def _hist_kernel(bins_ref, gh_ref, out_ref, acc_ref, *, F: int, B: int, blk: int):
+def _hist_kernel(bins_ref, gh_ref, out_ref, *, F: int, B: int, blk: int):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        out_ref[...] = jnp.zeros_like(out_ref)
 
     bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
     g = gh_ref[...].astype(jnp.bfloat16)  # (CH, blk)
     iota = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
     for f in range(F):
         onehot = (bt[:, f : f + 1] == iota).astype(jnp.bfloat16)  # (blk, B)
-        acc_ref[:, f * B : (f + 1) * B] += jnp.dot(
+        out_ref[:, f * B : (f + 1) * B] += jnp.dot(
             g, onehot, preferred_element_type=jnp.float32
         )
-
-    @pl.when(i == pl.num_programs(0) - 1)
-    def _flush():
-        out_ref[...] = acc_ref[...]
 
 
 def _hist_slots_kernel(
@@ -269,7 +265,6 @@ def hist_tpu(
         ],
         out_specs=pl.BlockSpec((CH, F * B), lambda i: (0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((CH, F * B), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((CH, F * B), jnp.float32)],
         interpret=interpret,
     )(bins_fm, gh8)
     return out.reshape(CH, F, B)

@@ -249,34 +249,85 @@ def grow_tree_rounds(
         )
 
         # ---- per-row split decision for all selected leaves at once ----
-        pl_c = jnp.minimum(s.pleaf, L - 1)  # invalid rows -> dead lanes
-        f_row = rec.feature[pl_c]
-        col_row = bundle.bundle_of[f_row] if spec.efb else f_row
+        # Every per-row leaf-dependent scalar (split column, threshold
+        # bin, default direction, slot rank, smaller side, membership)
+        # comes from ONE (N, S) @ (S, k) MXU contraction against the
+        # selected leaves' parameters. A (N,) jnp.take from an (L,)
+        # table costs ~1 ms each on TPU (no vector-gather hardware) and
+        # the old (L*B,) category-mask flat gather ~10 ms; the one-hot
+        # matmul is ~20 us for all of them together
+        # (tools/tpu_gather_probe.py). The contraction runs in f32:
+        # packed values include feature/column ids and bin thresholds,
+        # which exceed bf16's exact-integer range (256) on wide or
+        # deep-binned datasets; f32 is exact to 2^24 and the (N,S)@(S,9)
+        # matmul is far too small for the precision to cost wall time.
+        left_smaller = rec.left_c <= rec.right_c  # (L,) — GLOBAL counts,
+        # shard-consistent under data parallelism (derived from the
+        # psum'd parent histogram during split search)
+        sl_i = jnp.minimum(sel_leaf, L - 1)  # (S,) clipped for indexing
+        live = (sel_leaf < L).astype(jnp.float32)  # (S,) pad slots drop
+        feat_s = rec.feature[sl_i]  # (S,) tiny gathers from (L,) tables
+        col_s = bundle.bundle_of[feat_s] if spec.efb else feat_s
+        nan_s = nan_bin[feat_s]
+        pack_cols = [
+            col_s.astype(jnp.float32),  # 0: device bin column
+            rec.bin[sl_i].astype(jnp.float32),  # 1: threshold bin
+            rec.default_left[sl_i].astype(jnp.float32),  # 2
+            rec.is_cat[sl_i].astype(jnp.float32),  # 3
+            nan_s.astype(jnp.float32),  # 4: NaN bin (-1 = none)
+            iota_S.astype(jnp.float32),  # 5: slot rank
+            left_smaller[sl_i].astype(jnp.float32),  # 6
+            jnp.ones(S, jnp.float32),  # 7: membership indicator
+            feat_s.astype(jnp.float32),  # 8: true feature id (EFB decode)
+        ]
+        pack = jnp.stack(pack_cols, axis=1) * live[:, None]  # (S, 9) f32
+        memb = (s.pleaf[:, None] == sel_leaf[None, :])  # (N, S) one-hot
+        vals = lax.dot_general(
+            memb.astype(jnp.float32), pack, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (N, 9); rows outside every selected leaf are all-zero
+        in_split = vals[:, 7] > 0.5
+        col_row = vals[:, 0].astype(jnp.int32)
+        bin_row = vals[:, 1].astype(jnp.int32)
+        dl_row = vals[:, 2] > 0.5
+        cat_row = vals[:, 3] > 0.5
+        nan_row = vals[:, 4].astype(jnp.int32)
+        rank_row = vals[:, 5].astype(jnp.int32)
+        small_row = vals[:, 6] > 0.5
         # masked select of each row's split column (no 2D gather)
         col_sel = col_row[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]
         fbins = jnp.sum(jnp.where(col_sel, bins_fm, 0), axis=0)
         if spec.efb:
+            f_row = vals[:, 8].astype(jnp.int32)
             fbins = decode_feature_bins(fbins, f_row, bundle)
-        fnan_row = nan_bin[f_row]
-        cat_hit = rec.cat_mask.reshape(-1)[pl_c * B + jnp.minimum(fbins, B - 1)]
+        if spec.has_cat:
+            # category-set membership as a bin-one-hot contraction:
+            # hit[r] = cat_mask[slot(r), fbins[r]] without the (L*B,)
+            # flat gather
+            ob = (fbins[:, None] == jnp.arange(B, dtype=jnp.int32)[None, :])
+            cm_sel = (rec.cat_mask[sl_i].astype(jnp.bfloat16)
+                      * live[:, None])  # (S, B)
+            hits = lax.dot_general(
+                ob.astype(jnp.bfloat16), cm_sel,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (N, S)
+            cat_hit = jnp.sum(hits * memb, axis=1) > 0.5
+        else:
+            cat_hit = jnp.zeros_like(in_split)
         go_left = jnp.where(
-            rec.is_cat[pl_c],
+            cat_row,
             cat_hit,
-            (fbins <= rec.bin[pl_c])
-            | (rec.default_left[pl_c] & (fbins == fnan_row) & (fnan_row >= 0)),
+            (fbins <= bin_row)
+            | (dl_row & (fbins == nan_row) & (nan_row >= 0)),
         )
-        in_split = sel[pl_c] & (s.pleaf < L)
         pleaf_new = jnp.where(
-            in_split & ~go_left, new_id[pl_c], s.pleaf
+            in_split & ~go_left, i + 1 + rank_row, s.pleaf
         ).astype(jnp.int32)
 
         # ---- smaller-child histograms: one slot-packed pass ----
-        # left/right counts are GLOBAL (derived from the psum'd parent
-        # histogram during split search), so the smaller-side choice is
-        # shard-consistent under data parallelism.
-        left_smaller = rec.left_c <= rec.right_c  # (L,)
-        go_small = go_left == left_smaller[pl_c]
-        hslot = jnp.where(in_split & go_small, rank[pl_c], S).astype(jnp.int32)
+        go_small = go_left == small_row
+        hslot = jnp.where(in_split & go_small, rank_row, S).astype(jnp.int32)
         slot_hists = hist_nat_slots(
             bins_fm, gh8, hslot, S, Bc, quant=spec.quant
         )  # (S, 3, G, Bc)

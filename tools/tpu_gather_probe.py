@@ -1,0 +1,124 @@
+"""Probe per-row small-table gather strategies on a live chip.
+
+grow_tree_rounds' partition update gathers several (N,) values from
+(L,)-sized tables (row's leaf -> split feature/bin/default/new id).
+tools/tpu_rounds_profile.py measured the whole update at ~33 ms/round —
+dominant over the 12.4 ms histogram pass. Candidates:
+
+  take_L     — jnp.take from the (L,) table (current code)
+  onehot_S   — rows belong to <= S selected leaves: mask (N, S) =
+               (pleaf == sel_leaf) once, then ALL per-row scalars come
+               from one (N,S)@(S,k) MXU matmul
+  fori_S     — fori over S slots of masked scalar adds (VPU only)
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+
+    rs = np.random.RandomState(0)
+    N, L, S = 999424, 255, 25
+    pleaf = jnp.asarray(rs.randint(0, L, N).astype(np.int32))
+    sel_leaf = jnp.asarray(rs.choice(L, S, replace=False).astype(np.int32))
+    # k=4 per-leaf scalars to fetch per row (feature, bin, default, new_id)
+    tabs = jnp.asarray(rs.randint(0, 255, (L, 4)).astype(np.float32))
+
+    def timed(make_body, R=20):
+        def loop():
+            def body(_, acc):
+                return make_body(acc)
+
+            return lax.fori_loop(0, R, body, jnp.float32(0.0))
+
+        f = jax.jit(loop)
+        float(f())
+        t0 = time.time()
+        float(f())
+        return (time.time() - t0) / R
+
+    t_base = timed(lambda acc: acc + (pleaf + jnp.int32(acc)).astype(jnp.float32)[0])
+    print(json.dumps({"metric": "baseline_ms",
+                      "value": round(t_base * 1e3, 2)}), flush=True)
+
+    def take_body(acc):
+        p = pleaf + jnp.int32(acc * 0.0)
+        out = tabs[p]  # (N, 4) gather
+        return acc + out[0, 0]
+
+    t = timed(take_body) - t_base
+    print(json.dumps({"metric": "take_L_x4_ms", "value": round(t * 1e3, 2)}),
+          flush=True)
+
+    def onehot_body(acc):
+        p = pleaf + jnp.int32(acc * 0.0)
+        m = (p[:, None] == sel_leaf[None, :]).astype(jnp.bfloat16)  # (N, S)
+        st = tabs[sel_leaf].astype(jnp.bfloat16)  # (S, 4) small gather
+        out = jax.lax.dot_general(
+            m, st, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (N, 4)
+        return acc + out[0, 0]
+
+    t = timed(onehot_body) - t_base
+    print(json.dumps({"metric": "onehot_S_x4_ms", "value": round(t * 1e3, 2)}),
+          flush=True)
+
+    def fori_body(acc):
+        p = pleaf + jnp.int32(acc * 0.0)
+        st = tabs[sel_leaf]  # (S, 4)
+
+        def inner(s, o):
+            m = (p == sel_leaf[s]).astype(jnp.float32)
+            return o + m[:, None] * st[s][None, :]
+
+        out = lax.fori_loop(0, S, inner, jnp.zeros((N, 4), jnp.float32))
+        return acc + out[0, 0]
+
+    t = timed(fori_body) - t_base
+    print(json.dumps({"metric": "fori_S_x4_ms", "value": round(t * 1e3, 2)}),
+          flush=True)
+
+    # the (G, N) masked bin select (fbins) for comparison
+    G = 28
+    bins = jnp.asarray(rs.randint(0, 255, (G, N)).astype(np.int32))
+    col_row = jnp.asarray(rs.randint(0, G, N).astype(np.int32))
+
+    def fbins_body(acc):
+        cr = col_row + jnp.int32(acc * 0.0)
+        col_sel = cr[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]
+        fb = jnp.sum(jnp.where(col_sel, bins, 0), axis=0)
+        return acc + fb[0].astype(jnp.float32)
+
+    t = timed(fbins_body) - t_base
+    print(json.dumps({"metric": "fbins_select_ms", "value": round(t * 1e3, 2)}),
+          flush=True)
+
+    # cat-mask flat gather (the (L*B,) table path)
+    B = 256
+    cmask = jnp.asarray((rs.rand(L * B) > 0.5).astype(np.float32))
+    fbins_c = jnp.asarray(rs.randint(0, B, N).astype(np.int32))
+
+    def cat_body(acc):
+        p = pleaf + jnp.int32(acc * 0.0)
+        hit = cmask[p * B + fbins_c]
+        return acc + hit[0]
+
+    t = timed(cat_body) - t_base
+    print(json.dumps({"metric": "catmask_gather_ms", "value": round(t * 1e3, 2)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,220 @@
+"""Bisect grow_tree_rounds device cost on a live chip.
+
+The bench shows ~1.0 s/tree steady while the S=25 histogram pass is
+only ~12.5 ms (tools/tpu_hist_sweep.py) — so ~0.8 s/tree lives in the
+round body outside the hist kernel. This times each candidate in-jit
+(R data-dependent reps, one readback), mirroring the sweep methodology.
+
+Pieces:
+  full_tree       — grow_tree_rounds end to end
+  best_split_x50  — the vmapped child split search (2S = 50 leaves)
+  partition_upd   — the per-row split decision + pleaf update
+  hist_scatter    — the (L,3,G,Bc) pool double scatter
+  traverse_valid  — validation-set tree traversal (per-tree loop cost)
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import BinnedDataset
+    from lightgbm_tpu.learner import GrowerSpec, grow_tree, make_split_params
+    from lightgbm_tpu.learner.histogram import build_gh8, hist_nat_slots
+    from lightgbm_tpu.learner.split import best_split
+
+    print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+
+    rs = np.random.RandomState(0)
+    N, F, B, L, S = 999424, 28, 256, 255, 25
+    X = rs.randn(N, F).astype(np.float32)
+    cfg = Config({"max_bin": 255, "min_data_in_leaf": 20})
+    ds = BinnedDataset.from_numpy(X, cfg)
+    d = ds.device_arrays()
+    Np = ds.num_rows_padded()
+    grad = jnp.asarray(rs.randn(Np).astype(np.float32)) * d["valid"]
+    hess = jnp.ones(Np, jnp.float32) * 0.25 * d["valid"]
+    params = make_split_params(cfg)
+    fm = jnp.ones(ds.num_used_features, bool)
+    gh8 = build_gh8(grad, hess, d["valid"])
+    slot = jnp.asarray(rs.randint(0, S + 1, Np).astype(np.int32))
+
+    def timed(make_body, R=5):
+        def loop():
+            def body(_, acc):
+                return make_body(acc)
+
+            return lax.fori_loop(0, R, body, jnp.float32(0.0))
+
+        f = jax.jit(loop)
+        float(f())
+        t0 = time.time()
+        float(f())
+        return (time.time() - t0) / R
+
+    def report(name, t, note=""):
+        print(json.dumps({"metric": name, "value": round(t * 1e3, 1),
+                          "note": note}), flush=True)
+
+    # baseline chain
+    t_base = timed(lambda acc: acc + (grad + acc * 0.0)[0])
+    report("baseline_ms", t_base)
+
+    # ---- full tree ----
+    spec = GrowerSpec(num_leaves=L, num_bins=ds.max_num_bin, max_depth=-1,
+                      rounds_slots=S)
+
+    def tree_body(acc):
+        t_, rl = grow_tree(
+            d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+            grad + acc * 0.0, hess, d["valid"], fm, params, spec,
+            valid=d["valid"],
+        )
+        return acc + t_.leaf_value[0]
+
+    report("full_tree_ms", timed(tree_body, R=3) - t_base, "255 leaves S=25")
+
+    # ---- best_split vmapped over 50 children ----
+    hist50 = jnp.asarray(rs.rand(50, 3, F, B).astype(np.float32))
+    gsum = jnp.asarray(rs.randn(50).astype(np.float32))
+    hsum = jnp.abs(jnp.asarray(rs.randn(50).astype(np.float32))) + 1.0
+    csum = jnp.full(50, 1000.0)
+
+    def bs_body(acc):
+        h = hist50 + acc * 0.0
+
+        def one(hh, g_, h_, c_):
+            return best_split(hh, g_, h_, c_, d["num_bins"], d["nan_bin"],
+                              d["mono"], d["is_cat"], params, fm,
+                              cat_subset=spec.cat_subset,
+                              parent_output=jnp.float32(0.0))
+
+        rec = jax.vmap(one)(h, gsum, hsum, csum)
+        return acc + rec.gain[0]
+
+    report("best_split_x50_ms", timed(bs_body) - t_base)
+
+    # ---- partition update (per-row decision) ----
+    pleaf = jnp.asarray(rs.randint(0, L, Np).astype(np.int32))
+    feat_of_leaf = jnp.asarray(rs.randint(0, F, L).astype(np.int32))
+    bin_of_leaf = jnp.asarray(rs.randint(0, B, L).astype(np.int32))
+    sel = jnp.zeros(L, bool).at[jnp.arange(S)].set(True)
+    new_id = jnp.asarray(rs.randint(0, L, L).astype(np.int32))
+
+    def part_body(acc):
+        pl_c = jnp.minimum(pleaf + jnp.int32(acc * 0.0), L - 1)
+        f_row = feat_of_leaf[pl_c]
+        col_sel = f_row[None, :] == jnp.arange(F, dtype=jnp.int32)[:, None]
+        fbins = jnp.sum(jnp.where(col_sel, d["bins"], 0), axis=0)
+        go_left = fbins <= bin_of_leaf[pl_c]
+        in_split = sel[pl_c]
+        out = jnp.where(in_split & ~go_left, new_id[pl_c], pleaf)
+        return acc + out[0].astype(jnp.float32)
+
+    report("partition_upd_ms", timed(part_body) - t_base)
+
+    # ---- hist pool scatter ----
+    pool = jnp.zeros((L, 3, F, B), jnp.float32)
+    block = jnp.asarray(rs.rand(S, 3, F, B).astype(np.float32))
+    sel_leaf = jnp.asarray(rs.choice(L, S, replace=False).astype(np.int32))
+
+    def scat_body(acc):
+        p = pool.at[sel_leaf + jnp.int32(acc * 0.0)].set(block, mode="drop")
+        p = p.at[jnp.minimum(sel_leaf + 1, L - 1)].set(block, mode="drop")
+        return acc + p[0, 0, 0, 0]
+
+    report("hist_scatter_ms", timed(scat_body) - t_base)
+
+    # ---- nat hist pass (control; should match sweep) ----
+    def hist_body(acc):
+        out = hist_nat_slots(d["bins"], gh8 + acc * 0.0, slot, S, B)
+        return acc + out[0, 0, 0, 0]
+
+    report("hist_nat_S25_ms", timed(hist_body) - t_base)
+
+    # ---- valid traversal ----
+    from lightgbm_tpu.learner.grower import TreeArrays
+    from lightgbm_tpu.boosting import traverse_tree_bins
+
+    nv = 100_096
+    Xv = rs.randn(nv, F).astype(np.float32)
+    dsv = BinnedDataset.from_numpy(Xv, cfg)
+    dv = dsv.device_arrays()
+    tree = TreeArrays(
+        num_nodes=jnp.int32(L - 1),
+        node_feature=jnp.asarray(rs.randint(0, F, L - 1).astype(np.int32)),
+        node_bin=jnp.asarray(rs.randint(0, B, L - 1).astype(np.int32)),
+        node_gain=jnp.ones(L - 1, jnp.float32),
+        node_default_left=jnp.zeros(L - 1, bool),
+        node_cat=jnp.zeros(L - 1, bool),
+        node_cat_mask=jnp.zeros((L - 1, B), bool),
+        node_left=jnp.asarray((~np.arange(L - 1)).astype(np.int32)),
+        node_right=jnp.asarray((~(np.arange(L - 1) + 1)).astype(np.int32)),
+        node_value=jnp.zeros(L - 1, jnp.float32),
+        node_weight=jnp.ones(L - 1, jnp.float32),
+        node_count=jnp.ones(L - 1, jnp.float32),
+        leaf_value=jnp.zeros(L, jnp.float32),
+        leaf_weight=jnp.ones(L, jnp.float32),
+        leaf_count=jnp.ones(L, jnp.float32),
+        leaf_depth=jnp.ones(L, jnp.int32),
+    )
+
+    def trav_body(acc):
+        lf = traverse_tree_bins(
+            tree._replace(leaf_value=tree.leaf_value + acc * 0.0),
+            dv["bins"], dv["nan_bin"], dv.get("bundle"),
+        )
+        return acc + lf[0].astype(jnp.float32)
+
+    report("traverse_valid100k_ms", timed(trav_body) - t_base)
+
+    # ---- device AUC eval on the valid set ----
+    from lightgbm_tpu.device_metrics import DeviceEvalSet
+
+    yv = jnp.asarray((rs.rand(dsv.num_rows_padded()) > 0.5).astype(np.float32))
+    des = DeviceEvalSet(cfg, ["auc"], [True], yv, None, dv["valid"], 1)
+    sc = jnp.asarray(rs.randn(1, dsv.num_rows_padded()).astype(np.float32))
+
+    def auc_body(acc):
+        row = des(sc + acc * 0.0)
+        return acc + row[0]
+
+    report("device_auc100k_ms", timed(auc_body) - t_base)
+
+    # ---- add_score (train-score update via row->leaf gather) ----
+    from lightgbm_tpu.boosting import add_score
+
+    score0 = jnp.zeros(Np, jnp.float32)
+    lv = jnp.asarray(rs.randn(L).astype(np.float32))
+
+    def addsc_body(acc):
+        s = add_score(score0 + acc * 0.0, pleaf, lv, jnp.float32(1.0))
+        return acc + s[0]
+
+    report("add_score1M_ms", timed(addsc_body) - t_base)
+
+    # ---- binary-objective-shaped gradients over 1M (sigmoid math) ----
+    lab = jnp.asarray((rs.rand(Np) > 0.5).astype(np.float32))
+
+    def grad_body(acc):
+        s = score0 + acc * 0.0
+        p = jax.nn.sigmoid(s)
+        g_ = (p - lab)
+        h_ = p * (1.0 - p)
+        return acc + g_[0] + h_[0]
+
+    report("binary_grads1M_ms", timed(grad_body) - t_base)
+
+
+if __name__ == "__main__":
+    main()

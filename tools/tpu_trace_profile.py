@@ -1,0 +1,88 @@
+"""Where does the fused step's ~50 s first-call cost go?
+
+Builds a real Booster on the bench workload shapes, then times the
+jit stages of the fused step separately: trace (jaxpr), lower
+(StableHLO), compile (XLA; persistent-cache eligible). The trace+lower
+share is what every new Booster pays even with a warm compile cache —
+it is the part worth shrinking (or memoizing across Boosters).
+
+Usage: python tools/tpu_trace_profile.py [rows]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def main() -> None:
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    feats, leaves = 28, 255
+
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    rs = np.random.RandomState(17)
+    X = rs.randn(rows, feats).astype(np.float32)
+    y = (X[:, 0] + rs.randn(rows) > 0).astype(np.float32)
+    Xv = rs.randn(rows // 10, feats).astype(np.float32)
+    yv = (Xv[:, 0] + rs.randn(rows // 10) > 0).astype(np.float32)
+
+    params = {
+        "objective": "binary", "num_leaves": leaves, "max_bin": 255,
+        "metric": "auc", "verbosity": -1,
+    }
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    ds.construct()
+    vs = lgb.Dataset(Xv, label=yv, reference=ds, free_raw_data=False)
+
+    from lightgbm_tpu.basic import Booster
+
+    t0 = time.time()
+    bst = Booster(params=dict(params), train_set=ds)
+    bst.add_valid(vs, "v")
+    g = bst._gbdt
+    g.train.name = "training"
+    g.fused_start(track_train=False)
+    print(json.dumps({"stage": "setup_s",
+                      "value": round(time.time() - t0, 1)}), flush=True)
+
+    state = g._fstate
+    step = g._f_step
+
+    t0 = time.time()
+    traced = step.trace(state)
+    t_trace = time.time() - t0
+    print(json.dumps({"stage": "trace_s", "value": round(t_trace, 1)}),
+          flush=True)
+
+    t0 = time.time()
+    lowered = traced.lower()
+    t_lower = time.time() - t0
+    print(json.dumps({"stage": "lower_s", "value": round(t_lower, 1)}),
+          flush=True)
+
+    t0 = time.time()
+    compiled = lowered.compile()
+    t_compile = time.time() - t0
+    print(json.dumps({"stage": "compile_s", "value": round(t_compile, 1),
+                      "note": "persistent-cache eligible"}), flush=True)
+
+    # steady-state: run a few steps with one readback at the end
+    t0 = time.time()
+    n = 10
+    for _ in range(n):
+        state, trees, eval_row = compiled(state)
+    jax.device_get(eval_row)
+    t = (time.time() - t0) / n
+    print(json.dumps({"stage": "steady_step_ms",
+                      "value": round(t * 1e3, 1),
+                      "note": f"{n} fused steps, one readback"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
