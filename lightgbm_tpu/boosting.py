@@ -440,6 +440,10 @@ class GBDT:
             # num_grad_quant_bins rides the dequantized 5-channel path
             quant=bool(use_rounds and config.use_quantized_grad
                        and config.num_grad_quant_bins <= 256),
+            # levels within int8 range (g <= bins/2, h <= bins): the
+            # kernel runs s8 x s8 -> s32 on the MXU
+            quant_int8=bool(use_rounds and config.use_quantized_grad
+                            and config.num_grad_quant_bins <= 127),
             mono_mode=mono_mode,
             voting_k=config.top_k if use_voting else 0,
             extra_trees=use_extra,

@@ -121,6 +121,10 @@ class GrowerSpec(NamedTuple):
     # the reference's int16/int32 histogram path (bin.h:63-81,
     # feature_histogram.hpp:1062 int threshold scan).
     quant: bool = False
+    # quant levels fit int8 (num_grad_quant_bins <= 127): the slot-packed
+    # kernel runs s8 x s8 -> s32 on the MXU — twice the bf16 rate on v5e
+    # and bit-exact integer sums (bin.h:63-81 int histogram analog)
+    quant_int8: bool = False
     # monotone constraint method (monotone_constraints_method):
     # 0 = basic (children bounded at the split midpoint, inherited);
     # 1 = intermediate/advanced (monotone_constraints.hpp:516): per-leaf

@@ -209,6 +209,7 @@ def hist_nat_slots(
     num_slots: int,
     num_bins: int,
     quant: bool = False,  # gh8 built by build_gh8_quant (3 channels)
+    int8: bool = False,  # quant levels within +/-127: s8 MXU, s32 sums
 ) -> jax.Array:
     """Per-slot histograms keyed by a row->slot vector -> (S, 3, F, B).
 
@@ -223,15 +224,16 @@ def hist_nat_slots(
     its per-leaf row indices."""
     F, N = bins_fm.shape
     nat_ch = 3 if quant else NAT_CH
-    # VMEM guard: the kernel accumulates into its grid-constant output
-    # block of (chunk*nat_ch, F*B) f32; chunk the slot axis so it fits
-    # the ~16MB/core budget alongside the double-buffered input tiles
-    # (wide feature sets would otherwise fail the Mosaic compile on the
-    # default-on TPU path)
+    # VMEM guard: chunk the slot axis so the kernel's grid-constant
+    # output block stays within ~4MB. Calibrated against chip-measured
+    # scoped-VMEM outcomes (BENCH_NOTES r4): S=25 ch5 (3.59MB out) and
+    # S=42 ch3 (3.61MB) compile; S=50 ch5 (7.17MB) fails at 21.14M of
+    # the 16MB scoped budget — the W tile, per-feature one-hots and
+    # double-buffered inputs cost roughly 2x the output block again.
     per_slot = nat_ch * F * num_bins * 4
-    s_max = max(1, (12 * 2 ** 20) // max(per_slot, 1))
+    s_max = max(1, (4 * 2 ** 20) // max(per_slot, 1))
     if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
-            and per_slot <= 12 * 2 ** 20):
+            and per_slot <= 4 * 2 ** 20):
         from .pallas_hist import hist_nat_tpu
 
         parts = []
@@ -245,6 +247,7 @@ def hist_nat_slots(
             out = hist_nat_tpu(
                 bins_fm, gh8, local, sc, num_bins,
                 interpret=_interpret_pallas(), nat_ch=nat_ch,
+                int8=bool(int8 and quant),
             )  # (sc*nat_ch, F*B)
             o = out.reshape(sc, nat_ch, F, num_bins)
             if quant:

@@ -131,7 +131,8 @@ def grow_tree_rounds(
             root = lax.psum(root, ax)
         root = root * scale3
         hist0 = hist_nat_slots(
-            bins_fm, gh8, jnp.zeros(N, jnp.int32), 1, Bc, quant=True
+            bins_fm, gh8, jnp.zeros(N, jnp.int32), 1, Bc, quant=True,
+            int8=spec.quant_int8,
         )[0]
         if ax is not None:
             hist0 = lax.psum(hist0, ax)
@@ -282,9 +283,13 @@ def grow_tree_rounds(
         ]
         pack = jnp.stack(pack_cols, axis=1) * live[:, None]  # (S, 9) f32
         memb = (s.pleaf[:, None] == sel_leaf[None, :])  # (N, S) one-hot
+        # HIGHEST precision: the default TPU matmul multiplies f32 in
+        # bf16, which would corrupt packed ids above 256 — the exact
+        # case the f32 pack exists for
         vals = lax.dot_general(
             memb.astype(jnp.float32), pack, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
         )  # (N, 9); rows outside every selected leaf are all-zero
         in_split = vals[:, 7] > 0.5
         col_row = vals[:, 0].astype(jnp.int32)
@@ -329,7 +334,8 @@ def grow_tree_rounds(
         go_small = go_left == small_row
         hslot = jnp.where(in_split & go_small, rank_row, S).astype(jnp.int32)
         slot_hists = hist_nat_slots(
-            bins_fm, gh8, hslot, S, Bc, quant=spec.quant
+            bins_fm, gh8, hslot, S, Bc, quant=spec.quant,
+            int8=spec.quant_int8,
         )  # (S, 3, G, Bc)
         if ax is not None:
             slot_hists = lax.psum(slot_hists, ax)
@@ -339,7 +345,7 @@ def grow_tree_rounds(
         # ---- per-slot child hists: smaller from the pass, larger by
         # subtraction; scatter both into the pool. Work stays O(S), not
         # O(L) — only the <= S split leaves are touched.
-        sl_c = jnp.minimum(sel_leaf, L - 1)  # (S,) clipped for gathers
+        sl_c = sl_i  # (S,) clipped for gathers (computed above)
         parent_s = s.hist[sl_c]  # (S, 3, G, Bc)
         large_s = parent_s - slot_hists
         ls_s = left_smaller[sl_c][:, None, None, None]

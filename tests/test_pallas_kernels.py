@@ -81,6 +81,27 @@ def test_hist_nat_tpu_interpret_matches_fallback(interp, data):
                                atol=2e-3, rtol=1e-4)
 
 
+def test_hist_nat_int8_interpret_exact(interp, data):
+    """Quantized int8 mode: s8 x s8 -> s32 sums are EXACT integers and
+    must equal the f32 fallback bit-for-bit (integer levels within
+    +/-127 sum exactly in both paths at this size)."""
+    N, F, B, bins, _ = data
+    from lightgbm_tpu.learner.histogram import (
+        build_gh8_quant,
+        hist_nat_slots,
+    )
+
+    rs = np.random.RandomState(5)
+    gq = jnp.asarray(rs.randint(-2, 3, N).astype(np.float32))
+    hq = jnp.asarray(rs.randint(0, 5, N).astype(np.float32))
+    gh8q = build_gh8_quant(gq, hq, jnp.ones(N, jnp.float32))
+    S = 6
+    slot = jnp.asarray(rs.randint(0, S + 1, N).astype(np.int32))
+    out = hist_nat_slots(bins, gh8q, slot, S, B, quant=True, int8=True)
+    ref = _hist_nat_fallback(bins, gh8q, slot, S, B, quant=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_nat_grower_with_interpreted_kernel(interp):
     """End-to-end: the natural-order rounds grower with the interpreted
     slot-packed kernel matches the einsum-fallback grower exactly."""
