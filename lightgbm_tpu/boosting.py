@@ -19,6 +19,7 @@ self-contained.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -571,24 +572,28 @@ class GBDT:
             gk, hk, key, c.num_grad_quant_bins, c.stochastic_rounding
         )
 
-    def _grow_maybe_quantized(self, gk, hk, mask, feat_mask, valid, it, k):
+    def _grow_maybe_quantized(self, gk, hk, mask, feat_mask, valid, it, k,
+                              bins=None):
         """One tree: quantize gradients first when use_quantized_grad
         (all paths — fast, fused, sync/DART, RF — share this so none can
         silently skip quantization), optionally renewing leaf outputs
         with the true gradients afterward."""
         c = self.config
         if not c.use_quantized_grad:
-            return self._grow(gk, hk, mask, feat_mask, valid, it, k)
+            return self._grow(gk, hk, mask, feat_mask, valid, it, k,
+                              bins=bins)
         gq, hq, scale = self._quantize(gk, hk, it, k)
         if self.spec.quant:
             # rounds grower consumes the integer levels directly: exact
             # int histogram sums in 3 channels/slot (42 slots/MXU pass)
             arrays, row_leaf = self._grow(
-                gq, hq, mask, feat_mask, valid, it, k, gh_scale=scale
+                gq, hq, mask, feat_mask, valid, it, k, gh_scale=scale,
+                bins=bins,
             )
         else:
             arrays, row_leaf = self._grow(
-                gq * scale[0], hq * scale[1], mask, feat_mask, valid, it, k
+                gq * scale[0], hq * scale[1], mask, feat_mask, valid, it, k,
+                bins=bins,
             )
         if c.quant_train_renew_leaf and self._quant_renew_ok:
             from .learner.quantize import renew_leaf_with_true_gradients
@@ -615,14 +620,18 @@ class GBDT:
         )
 
     # ------------------------------------------------------------------
-    def _grow(self, gk, hk, mask, feat_mask, valid, it=0, k=0, gh_scale=None):
+    def _grow(self, gk, hk, mask, feat_mask, valid, it=0, k=0, gh_scale=None,
+              bins=None):
         """Grow one tree on the training set — serial, or sharded over the
         data mesh when tree_learner=data/voting (lockstep trees on every
         shard, reference data_parallel_tree_learner.cpp). Traceable: used
-        both eagerly and inside the fused jit step (it may be traced)."""
+        both eagerly and inside the fused jit step (it may be traced).
+        `bins` overrides the training bin matrix — the fused step passes
+        its traced jit-argument copy so the executable doesn't embed the
+        matrix as a constant."""
         import jax
 
-        d = self.dev
+        d = self.dev if bins is None else dict(self.dev, bins=bins)
         rng_key = None
         if self._node_key is not None:
             rng_key = jax.random.fold_in(
@@ -1083,12 +1092,19 @@ class GBDT:
         objective = self.objective
         strategy = self.strategy
         dev = self.dev
-        traverse = traverse_tree_bins
+        # all-numerical datasets statically skip the category-set test
+        # in the per-iteration valid traversal (hot: runs inside step)
+        traverse = partial(traverse_tree_bins, has_cat=self.spec.has_cat)
         renew_alpha, renew_w = self._renewal_setup()
         label_dev = self._label_dev
         track_train_eval = track_train
 
-        def step(state):
+        def step(state, data):
+            # `data` carries the BIG loop-invariant arrays (train + valid
+            # bin matrices — 112 MB at 1M x 28) as a jit ARGUMENT: as
+            # closure captures they are embedded in the executable as
+            # constants (152 MB jit_step, 57 s compile). NOT donated, so
+            # the caller's handles stay valid for the sync/predict paths.
             score = state["score"]
             vscores = state["vscores"]
             it = state["it"]
@@ -1110,30 +1126,37 @@ class GBDT:
                 else:
                     feat_mask = jnp.ones(F, dtype=bool)
                 arrays, row_leaf = self._grow_maybe_quantized(
-                    gk, hk, mask, feat_mask, dev["valid"], it, k
+                    gk, hk, mask, feat_mask, dev["valid"], it, k,
+                    bins=data["bins"],
                 )
                 ok = (arrays.num_nodes > 0).astype(jnp.float32)
                 if renew_alpha is not None:
                     # percentile leaf refit on device (RenewTreeOutput,
                     # gbdt.cpp:418 — before shrinkage, in-bag rows only)
                     arrays = self._apply_renewal(
-                        arrays, row_leaf, score[k], mask, renew_alpha, renew_w
+                        arrays, row_leaf, score[k], mask, renew_alpha,
+                        renew_w
                     )
                 lv = arrays.leaf_value * (shrink * ok)
                 one = jnp.float32(1.0)
-                score = score.at[k].set(add_score(score[k], row_leaf, lv, one))
+                score = score.at[k].set(
+                    add_score(score[k], row_leaf, lv, one)
+                )
                 new_vs = []
                 for vi in range(n_valid_sets):
-                    vleaf = traverse(arrays, vdevs[vi]["bins"], vdevs[vi]["nan_bin"], vdevs[vi].get("bundle"))
+                    vleaf = traverse(
+                        arrays, data["vbins"][vi], vdevs[vi]["nan_bin"],
+                        vdevs[vi].get("bundle"),
+                    )
                     new_vs.append(
                         vscores[vi].at[k].set(
                             add_score(vscores[vi][k], vleaf, lv, one)
                         )
                     )
                 vscores = tuple(new_vs)
-                # stored tree carries the boost-from-average bias on the
-                # first iteration only (AddBias, gbdt.cpp:424); the score
-                # got it at fused_start
+                # stored tree carries the boost-from-average bias on
+                # the first iteration only (AddBias, gbdt.cpp:424);
+                # the score got it at fused_start
                 lv_stored = lv + init_vec[k] * ok * (it == 0)
                 trees.append(arrays._replace(leaf_value=lv_stored))
             # metric evaluation entirely on device
@@ -1152,6 +1175,10 @@ class GBDT:
             return new_state, tuple(trees), eval_row
 
         self._f_step = jax.jit(step, donate_argnums=(0,))
+        self._f_data = {
+            "bins": self.dev["bins"],
+            "vbins": [vd["bins"] for vd in vdevs],
+        }
 
     def fused_start(self, track_train: bool) -> None:
         """Initialize the device loop state; performs BoostFromAverage."""
@@ -1187,7 +1214,9 @@ class GBDT:
     def fused_dispatch(self, n: int) -> None:
         """Dispatch n fused iterations without any host synchronization."""
         for _ in range(n):
-            self._fstate, trees, eval_row = self._f_step(self._fstate)
+            self._fstate, trees, eval_row = self._f_step(
+                self._fstate, self._f_data
+            )
             for k, arrays in enumerate(trees):
                 self.device_trees.append((arrays, None))
                 self._pending.append(arrays)

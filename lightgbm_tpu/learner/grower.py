@@ -622,5 +622,11 @@ def _grow_tree_flat(
 def add_score(score: jax.Array, row_leaf: jax.Array, leaf_value: jax.Array,
               shrinkage: jax.Array) -> jax.Array:
     """ScoreUpdater::AddScore via the partition vector
-    (reference score_updater.hpp:21 + data-partition fast path)."""
-    return score + shrinkage * leaf_value[row_leaf]
+    (reference score_updater.hpp:21 + data-partition fast path).
+
+    The (N,) lookup from the (L,) leaf table rides the one-hot MXU
+    contraction (take_cols): a plain take costs ~8 ms per 1M rows on
+    TPU. Invalid rows (row_leaf == -1) contribute 0 on that path."""
+    from .histogram import take_cols
+
+    return score + shrinkage * take_cols(leaf_value[None, :], row_leaf)[0]

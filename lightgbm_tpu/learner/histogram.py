@@ -261,6 +261,41 @@ def hist_nat_slots(
                               quant=quant)
 
 
+def take_cols(tab: jax.Array, idx: jax.Array) -> jax.Array:
+    """(k, L) table, (N,) int32 indices -> (k, N) tab[:, idx].
+
+    TPU: one-hot MXU contraction (pallas take_small_tpu, ~0.1 ms at 1M
+    rows); elsewhere (or unaligned N): plain take. Negative / >= L
+    indices return 0 on the kernel path and must be pre-clipped by
+    callers that rely on take's wrapping (none do)."""
+    N = idx.shape[0]
+    if _use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK:
+        from .pallas_hist import take_small_tpu
+
+        return take_small_tpu(tab, idx, interpret=_interpret_pallas())
+    L = tab.shape[1]
+    out = jnp.take(tab, jnp.clip(idx, 0, L - 1), axis=1)
+    return jnp.where(((idx >= 0) & (idx < L))[None, :], out, 0.0)
+
+
+def seg_sum(vals: jax.Array, idx: jax.Array, num_out: int) -> jax.Array:
+    """(k, N) values + (N,) int32 indices -> (k, num_out) per-index
+    column sums. TPU: one-hot MXU contraction (pallas seg_sum_tpu);
+    elsewhere: XLA scatter-add. Out-of-range indices are dropped on
+    both paths."""
+    k, N = vals.shape
+    if _use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK:
+        from .pallas_hist import seg_sum_tpu
+
+        return seg_sum_tpu(vals, idx, num_out,
+                           interpret=_interpret_pallas())
+    in_range = (idx >= 0) & (idx < num_out)
+    safe = jnp.where(in_range, idx, num_out)  # num_out -> dropped
+    return jnp.zeros((k, num_out), vals.dtype).at[:, safe].add(
+        jnp.where(in_range[None, :], vals, 0.0), mode="drop"
+    )
+
+
 def gather_rows(bins_fm: jax.Array, idx: jax.Array) -> jax.Array:
     """Gather rows (lane axis) by index -> (F, len(idx)). Out-of-range
     idx (pad slots) fill with bin 0; callers zero their gh so those rows

@@ -70,11 +70,25 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref,
     slot = slot_ref[0, :]  # (blk,) int32
     gh = gh_ref[...]  # (CH, blk) f32; rows 0..nat_ch-1 are live
     iota_s = lax.broadcasted_iota(jnp.int32, (S, blk), 0)
-    sl = (slot[None, :] == iota_s).astype(dt)  # (S, blk)
-    g5 = gh[:nat_ch, :].astype(dt)  # (nat_ch, blk)
-    W = (sl[:, None, :] * g5[None, :, :]).reshape(S * nat_ch, blk)
+    if int8:
+        # Mosaic has no elementwise i8 multiply (only the MXU dot is
+        # int8-legal): mask the levels in i32, then narrow to s8
+        sl32 = (slot[None, :] == iota_s).astype(jnp.int32)  # (S, blk)
+        g32 = gh[:nat_ch, :].astype(jnp.int32)  # (nat_ch, blk)
+        W = (sl32[:, None, :] * g32[None, :, :]).reshape(
+            S * nat_ch, blk
+        ).astype(jnp.int8)
+    else:
+        sl = (slot[None, :] == iota_s).astype(dt)  # (S, blk)
+        g5 = gh[:nat_ch, :].astype(dt)  # (nat_ch, blk)
+        W = (sl[:, None, :] * g5[None, :, :]).reshape(S * nat_ch, blk)
 
     bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
+    # one (M, blk) @ (blk, B) matmul per feature. Grouping features into
+    # wider matmuls was tried and measured SLOWER (lane-axis concat of
+    # one-hots cost more than the larger matmul saved: 4.75 -> 3.71
+    # trees/s end to end; 3D->2D reshapes onto the lane axis don't
+    # lower in Mosaic at all)
     iota_b = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
     for f in range(F):
         onehot = (bt[:, f : f + 1] == iota_b).astype(dt)  # (blk, B)
@@ -125,6 +139,100 @@ def hist_nat_tpu(
         interpret=interpret,
     )(bins_fm, gh8, slot.reshape(1, N))
     return out if not int8 else out.astype(jnp.float32)
+
+
+def _take_kernel(idx_ref, tab_ref, out_ref, *, L: int, k: int, blk: int):
+    """out[:, r] = tab[:, idx[r]] as a one-hot MXU contraction.
+
+    A (N,) vector gather from a small table costs ~1 ms per 1M rows on
+    TPU (no vector-gather hardware); this does the same lookup as
+    (k, L) @ (L, blk) one-hot matmuls per tile, ~0.1 ms for the whole
+    array (tools/tpu_gather_probe.py). HIGHEST precision: table VALUES
+    are arbitrary f32 (leaf outputs) and the default TPU matmul would
+    round them to bf16; with a 0/1 one-hot operand the HIGHEST-precision
+    product is exact."""
+    idx = idx_ref[0, :]  # (blk,) int32
+    iota_l = lax.broadcasted_iota(jnp.int32, (L, blk), 0)
+    onehot = (idx[None, :] == iota_l).astype(jnp.float32)  # (L, blk)
+    out_ref[...] = lax.dot_general(
+        tab_ref[...], onehot, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("blk", "interpret"))
+def take_small_tpu(
+    tab: jax.Array,  # (k, L) f32 — k table columns, L entries each
+    idx: jax.Array,  # (N,) int32; out-of-range rows produce 0
+    blk: int = HIST_BLK,
+    interpret: bool = False,
+) -> jax.Array:
+    """(k, N) f32: tab[:, idx] via per-tile one-hot contraction."""
+    k, L = tab.shape
+    N = idx.shape[0]
+    assert N % blk == 0, (N, blk)
+    nb = N // blk
+    return pl.pallas_call(
+        functools.partial(_take_kernel, L=L, k=k, blk=blk),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, L), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, blk), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, N), jnp.float32),
+        interpret=interpret,
+    )(idx.reshape(1, N), tab)
+
+
+def _segsum_kernel(idx_ref, val_ref, out_ref, *, L: int, k: int, blk: int):
+    """out[:, l] += sum over rows r with idx[r] == l of val[:, r] —
+    per-leaf reductions (RenewTreeOutput sums) as a one-hot MXU
+    contraction instead of an XLA scatter-add (which serializes on TPU).
+    Out-of-range idx (invalid rows, idx == L or -1) match nothing.
+    HIGHEST precision: values are arbitrary f32 gradients."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    idx = idx_ref[0, :]  # (blk,) int32
+    iota_l = lax.broadcasted_iota(jnp.int32, (blk, L), 1)
+    onehot = (idx[:, None] == iota_l).astype(jnp.float32)  # (blk, L)
+    out_ref[...] += lax.dot_general(
+        val_ref[...], onehot, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_out", "blk", "interpret"))
+def seg_sum_tpu(
+    vals: jax.Array,  # (k, N) f32
+    idx: jax.Array,  # (N,) int32; out-of-range rows contribute nothing
+    num_out: int,
+    blk: int = HIST_BLK,
+    interpret: bool = False,
+) -> jax.Array:
+    """(k, num_out) f32 per-index sums of vals columns."""
+    k, N = vals.shape
+    assert N % blk == 0, (N, blk)
+    nb = N // blk
+    return pl.pallas_call(
+        functools.partial(_segsum_kernel, L=num_out, k=k, blk=blk),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, num_out), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, num_out), jnp.float32),
+        interpret=interpret,
+    )(idx.reshape(1, N), vals)
 
 
 def _hist_kernel(bins_ref, gh_ref, out_ref, *, F: int, B: int, blk: int):

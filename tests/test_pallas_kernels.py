@@ -102,6 +102,30 @@ def test_hist_nat_int8_interpret_exact(interp, data):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_take_and_segsum_interpret(interp, data):
+    """take_cols / seg_sum one-hot contraction paths vs plain XLA."""
+    N, F, B, bins, _ = data
+    from lightgbm_tpu.learner.histogram import seg_sum, take_cols
+
+    rs = np.random.RandomState(6)
+    L = 31
+    tab = jnp.asarray(rs.randn(3, L).astype(np.float32))
+    idx = jnp.asarray(rs.randint(-1, L, N).astype(np.int32))  # -1 = dead
+    out = np.asarray(take_cols(tab, idx))
+    ref = np.where(np.asarray(idx)[None, :] >= 0,
+                   np.asarray(tab)[:, np.clip(np.asarray(idx), 0, L - 1)],
+                   0.0)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    vals = jnp.asarray(rs.randn(2, N).astype(np.float32))
+    s = np.asarray(seg_sum(vals, idx, L))
+    refsum = np.zeros((2, L), np.float32)
+    ii = np.asarray(idx)
+    for l in range(L):
+        refsum[:, l] = np.asarray(vals)[:, ii == l].sum(axis=1)
+    np.testing.assert_allclose(s, refsum, atol=1e-3, rtol=1e-5)
+
+
 def test_nat_grower_with_interpreted_kernel(interp):
     """End-to-end: the natural-order rounds grower with the interpreted
     slot-packed kernel matches the einsum-fallback grower exactly."""

@@ -52,10 +52,11 @@ def main() -> None:
                       "value": round(time.time() - t0, 1)}), flush=True)
 
     state = g._fstate
+    data = g._f_data
     step = g._f_step
 
     t0 = time.time()
-    traced = step.trace(state)
+    traced = step.trace(state, data)
     t_trace = time.time() - t0
     print(json.dumps({"stage": "trace_s", "value": round(t_trace, 1)}),
           flush=True)
@@ -76,7 +77,7 @@ def main() -> None:
     t0 = time.time()
     n = 10
     for _ in range(n):
-        state, trees, eval_row = compiled(state)
+        state, trees, eval_row = compiled(state, data)
     jax.device_get(eval_row)
     t = (time.time() - t0) / n
     print(json.dumps({"stage": "steady_step_ms",
