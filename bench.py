@@ -56,8 +56,8 @@ LAST_TPU_VERIFIED = {
     "platform": "tpu",
     "round": 4,
     "auc_valid": 0.98421,
-    "quantized_trees_per_sec": 5.5554,
-    "quantized_auc_valid": 0.98424,
+    "quantized_trees_per_sec": 5.7473,
+    "quantized_auc_valid": 0.98408,
     "note": "steady-state over the last fused chunk; default config; "
             "quantized = use_quantized_grad int8 MXU path",
 }
@@ -273,7 +273,7 @@ def main() -> None:
     }
     if os.environ.get("BENCH_QUANT"):
         # quantized-gradient training (use_quantized_grad): int8 MXU
-        # histograms, 42 slots/pass — the reference's quantized mode
+        # histograms, 48 slots/pass — the reference's quantized mode
         # with its recommended leaf renewal
         params.update(use_quantized_grad=True, num_grad_quant_bins=4,
                       quant_train_renew_leaf=True)

@@ -431,9 +431,15 @@ class GBDT:
             col_bins=train_set.col_bins,
             rounds=(config.tpu_growth_rounds and not use_rounds
                     and rounds_ok),
+            # slot defaults are chip-tuned END TO END (BENCH_NOTES r4):
+            # quant ch3 S=48 (0.258 ms/split) beat 42; non-quant S=32
+            # measured SLOWER than 25 end to end (4.39 vs 4.75 trees/s
+            # — the wider pass wastes width on candidate-limited
+            # rounds) so 25 stays; larger S fails the scoped-VMEM
+            # compile (ch5 >32, ch3 >48)
             rounds_slots=(
                 min(config.tpu_round_slots
-                    or (42 if config.use_quantized_grad else 25),
+                    or (48 if config.use_quantized_grad else 25),
                     config.num_leaves)
                 if use_rounds else 0
             ),
@@ -585,7 +591,7 @@ class GBDT:
         gq, hq, scale = self._quantize(gk, hk, it, k)
         if self.spec.quant:
             # rounds grower consumes the integer levels directly: exact
-            # int histogram sums in 3 channels/slot (42 slots/MXU pass)
+            # int histogram sums in 3 channels/slot (48 slots/MXU pass)
             arrays, row_leaf = self._grow(
                 gq, hq, mask, feat_mask, valid, it, k, gh_scale=scale,
                 bins=bins,

@@ -116,7 +116,7 @@ class GrowerSpec(NamedTuple):
     rounds_slots: int = 0
     # quantized-gradient channels in rounds mode (use_quantized_grad):
     # grad/hess arrive as INTEGER levels, histograms accumulate exact
-    # int sums in 3 bf16 channels per slot (42 slots/pass vs 25), and
+    # int sums in 3 bf16 channels per slot (48 slots/pass vs 25), and
     # the split scan runs on scale-multiplied sums — the TPU analog of
     # the reference's int16/int32 histogram path (bin.h:63-81,
     # feature_histogram.hpp:1062 int threshold scan).

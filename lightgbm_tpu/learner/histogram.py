@@ -196,7 +196,7 @@ def build_gh8_quant(gq: jax.Array, hq: jax.Array, count: jax.Array) -> jax.Array
     """Quantized-channel layout: (g_int, h_int, count, 0, ...). Integer
     levels (|g| <= num_grad_quant_bins/2 etc.) are exact in bf16, so the
     hi/lo split is unnecessary — 3 channels per slot instead of 5 packs
-    42 slots per MXU pass (the TPU analog of the reference's int16
+    48 slots per MXU pass (the TPU analog of the reference's int16
     histogram entries, bin.h:63-81)."""
     z = jnp.zeros_like(count)
     return jnp.stack([gq, hq, count, z, z, z, z, z])
@@ -225,15 +225,17 @@ def hist_nat_slots(
     F, N = bins_fm.shape
     nat_ch = 3 if quant else NAT_CH
     # VMEM guard: chunk the slot axis so the kernel's grid-constant
-    # output block stays within ~4MB. Calibrated against chip-measured
-    # scoped-VMEM outcomes (BENCH_NOTES r4): S=25 ch5 (3.59MB out) and
-    # S=42 ch3 (3.61MB) compile; S=50 ch5 (7.17MB) fails at 21.14M of
-    # the 16MB scoped budget — the W tile, per-feature one-hots and
-    # double-buffered inputs cost roughly 2x the output block again.
+    # output block stays within the scoped budget. Chip-calibrated
+    # (BENCH_NOTES r4): ch5 S=32 (4.59MB out) and ch3 S=48 (3.94MB)
+    # compile; ch5 S=36 and ch3 S=56 fail — the W tile, per-feature
+    # one-hots and double-buffered inputs cost roughly 2x the output
+    # block again. The byte formula guards wide feature sets; the
+    # empirical per-channel-count cap guards the slot axis.
     per_slot = nat_ch * F * num_bins * 4
-    s_max = max(1, (4 * 2 ** 20) // max(per_slot, 1))
+    s_cap = 32 if nat_ch >= 5 else 48
+    s_max = max(1, min(int(4.6 * 2 ** 20) // max(per_slot, 1), s_cap))
     if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
-            and per_slot <= 4 * 2 ** 20):
+            and per_slot <= int(4.6 * 2 ** 20)):
         from .pallas_hist import hist_nat_tpu
 
         parts = []
