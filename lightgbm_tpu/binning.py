@@ -64,6 +64,19 @@ def greedy_find_bin(
     bub: List[float] = []
     if num_distinct == 0:
         return [float("inf")]
+    if num_distinct > 512:
+        # the pure-Python greedy loop costs ~110 ms per 200k distinct
+        # values; the native library is the same double arithmetic in
+        # C++ (bit-exact — asserted by the binning parity tests)
+        from . import native
+
+        nb = native.greedy_find_bin(
+            np.asarray(distinct_values, np.float64),
+            np.asarray(counts, np.int64),
+            max_bin, total_cnt, min_data_in_bin,
+        )
+        if nb is not None:
+            return [float(v) for v in nb]
     if num_distinct <= max_bin:
         cur_cnt_inbin = 0
         for i in range(num_distinct - 1):
@@ -102,8 +115,12 @@ def greedy_find_bin(
         if (
             is_big[i]
             or cur_cnt_inbin >= mean_bin_size
+            # reference bin.cpp:132 writes `mean_bin_size * 0.5f`, but
+            # C++ promotes the float literal to double — plain 0.5 here;
+            # np.float32(0.5) would compute the product in f32 under
+            # NumPy-2 weak promotion and diverge from the reference
             or (is_big[i + 1]
-                and cur_cnt_inbin >= max(1.0, mean_bin_size * np.float32(0.5)))
+                and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))
         ):
             uppers[bin_cnt] = float(distinct_values[i])
             bin_cnt += 1
@@ -334,17 +351,22 @@ class BinMapper:
                 out = np.where(found, vals[idx], nan_bin).astype(np.int32)
             out[ints < 0] = nan_bin
             return out
+        nan_target = (
+            self.num_bin - 1 if self.missing_type == MissingType.NAN
+            else self.default_bin
+        )
+        if len(values) > (1 << 15):
+            from . import native
+
+            out = native.values_to_bins(values, self.upper_bounds, nan_target)
+            if out is not None:
+                return out
         nan_mask = np.isnan(values)
         vv = np.where(nan_mask, 0.0, values)
         bins = np.searchsorted(self.upper_bounds, vv, side="left").astype(np.int32)
         n_numeric_bins = len(self.upper_bounds)
         bins = np.clip(bins, 0, n_numeric_bins - 1)
-        if self.missing_type == MissingType.NAN:
-            bins[nan_mask] = self.num_bin - 1
-        elif self.missing_type == MissingType.ZERO:
-            bins[nan_mask] = self.default_bin
-        else:
-            bins[nan_mask] = self.default_bin
+        bins[nan_mask] = nan_target
         return bins
 
     def bin_to_value(self, bin_idx: int) -> float:

@@ -63,23 +63,51 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB)
         except OSError:
             return None
-        lib.fp_parse_delim.restype = ctypes.c_int
-        lib.fp_parse_delim.argtypes = [
-            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.fp_parse_libsvm.restype = ctypes.c_int
-        lib.fp_parse_libsvm.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.fp_free.restype = None
-        lib.fp_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+        try:
+            _bind(lib)
+        except AttributeError:
+            # stale cached .so (newer mtime than the source but built
+            # from an older version, e.g. rsync -t / restored backup):
+            # rebuild once, then give up gracefully
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+                _bind(lib)
+            except (OSError, AttributeError):
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.fp_parse_delim.restype = ctypes.c_int
+    lib.fp_parse_delim.argtypes = [
+        ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.fp_parse_libsvm.restype = ctypes.c_int
+    lib.fp_parse_libsvm.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.fp_free.restype = None
+    lib.fp_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+    lib.fp_greedy_find_bin.restype = ctypes.c_int64
+    lib.fp_greedy_find_bin.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.fp_values_to_bins.restype = None
+    lib.fp_values_to_bins.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+    ]
 
 
 def _take(lib, ptr, shape) -> np.ndarray:
@@ -103,6 +131,45 @@ def parse_delim(path: str, delim: str, skip_rows: int) -> Optional[np.ndarray]:
     if rc != 0:
         return None
     return _take(lib, out, (rows.value, cols.value))
+
+
+def greedy_find_bin(distinct: np.ndarray, counts: np.ndarray, max_bin: int,
+                    total_cnt: int, min_data_in_bin: int
+                    ) -> Optional[np.ndarray]:
+    """Native GreedyFindBin (bit-exact C++ mirror of binning.py:46 /
+    reference bin.cpp:80); None when the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    distinct = np.ascontiguousarray(distinct, dtype=np.float64)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    out = np.empty(max(int(max_bin), 1) + 2, dtype=np.float64)
+    n = lib.fp_greedy_find_bin(
+        distinct.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(distinct), int(max_bin), int(total_cnt), int(min_data_in_bin),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out[:n]
+
+
+def values_to_bins(values: np.ndarray, bounds: np.ndarray, nan_target: int
+                   ) -> Optional[np.ndarray]:
+    """Native multithreaded numerical ValueToBin; None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    bounds = np.ascontiguousarray(bounds, dtype=np.float64)
+    out = np.empty(len(values), dtype=np.int32)
+    lib.fp_values_to_bins(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(values),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(bounds), int(nan_target),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out
 
 
 def parse_libsvm(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:

@@ -11,9 +11,11 @@
 // Plain C ABI on purpose: no Python.h, no pybind11 — the caller owns
 // NumPy allocation and copies out of the returned malloc'd buffer.
 
+#include <algorithm>
 #include <cctype>
 #include <cerrno>
 #include <cmath>
+#include <limits>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -297,6 +299,125 @@ int fp_parse_libsvm(const char* path, double** out, double** out_label,
   *out_rows = n_rows;
   *out_cols = n_cols;
   return 0;
+}
+
+// ---------------------------------------------------------------- binning
+// GreedyFindBin (reference src/io/bin.cpp:80), bit-identical to the
+// Python mirror in binning.py:46 — the Python greedy loop over a 200k
+// distinct-value sample costs ~110 ms per call (~6 s of a 1M x 28
+// Dataset construct); this is the same double arithmetic in C++.
+
+static bool check_double_equal_ordered(double a, double b) {
+  return b <= std::nextafter(a, std::numeric_limits<double>::infinity());
+}
+
+// out must hold max_bin + 2 doubles; returns the number of bounds.
+int64_t fp_greedy_find_bin(const double* distinct, const int64_t* counts,
+                           int64_t n, int64_t max_bin, int64_t total_cnt,
+                           int64_t min_data_in_bin, double* out) {
+  const double kInf = std::numeric_limits<double>::infinity();
+  int64_t nb = 0;
+  if (n == 0) {
+    out[nb++] = kInf;
+    return nb;
+  }
+  if (n <= max_bin) {
+    int64_t cur = 0;
+    for (int64_t i = 0; i + 1 < n; ++i) {
+      cur += counts[i];
+      if (cur >= min_data_in_bin) {
+        double val = std::nextafter((distinct[i] + distinct[i + 1]) / 2.0,
+                                    kInf);
+        if (nb == 0 || !check_double_equal_ordered(out[nb - 1], val)) {
+          out[nb++] = val;
+          cur = 0;
+        }
+      }
+    }
+    out[nb++] = kInf;
+    return nb;
+  }
+
+  if (min_data_in_bin > 0) {
+    int64_t mb = total_cnt / min_data_in_bin;
+    if (mb < max_bin) max_bin = mb;
+    if (max_bin < 1) max_bin = 1;
+  }
+  double mean_bin_size = static_cast<double>(total_cnt) / max_bin;
+  std::vector<char> is_big(n);
+  int64_t big_cnt = 0, big_data = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    is_big[i] = counts[i] >= mean_bin_size;
+    if (is_big[i]) {
+      ++big_cnt;
+      big_data += counts[i];
+    }
+  }
+  int64_t rest_bin_cnt = max_bin - big_cnt;
+  int64_t rest_sample_cnt = total_cnt - big_data;
+  mean_bin_size = rest_bin_cnt > 0
+                      ? static_cast<double>(rest_sample_cnt) / rest_bin_cnt
+                      : kInf;
+  std::vector<double> uppers(max_bin, kInf), lowers(max_bin, kInf);
+  int64_t bin_cnt = 0;
+  lowers[0] = distinct[0];
+  int64_t cur = 0;
+  for (int64_t i = 0; i + 1 < n; ++i) {
+    if (!is_big[i]) rest_sample_cnt -= counts[i];
+    cur += counts[i];
+    if (is_big[i] || cur >= mean_bin_size ||
+        (is_big[i + 1] &&
+         cur >= std::max(1.0, mean_bin_size * 0.5))) {
+      uppers[bin_cnt] = distinct[i];
+      ++bin_cnt;
+      lowers[bin_cnt] = distinct[i + 1];
+      if (bin_cnt >= max_bin - 1) break;
+      cur = 0;
+      if (!is_big[i]) {
+        --rest_bin_cnt;
+        mean_bin_size = rest_bin_cnt > 0
+                            ? static_cast<double>(rest_sample_cnt) /
+                                  rest_bin_cnt
+                            : kInf;
+      }
+    }
+  }
+  ++bin_cnt;
+  for (int64_t i = 0; i + 1 < bin_cnt; ++i) {
+    double val = std::nextafter((uppers[i] + lowers[i + 1]) / 2.0, kInf);
+    if (nb == 0 || !check_double_equal_ordered(out[nb - 1], val)) {
+      out[nb++] = val;
+    }
+  }
+  out[nb++] = kInf;
+  return nb;
+}
+
+// Vectorized numerical ValueToBin (reference bin.h:161; the Python
+// np.searchsorted path is single-threaded): first index with
+// bounds[i] >= v (lower_bound), NaN -> nan_target. Multithreaded.
+void fp_values_to_bins(const double* values, int64_t n, const double* bounds,
+                       int64_t nb, int32_t nan_target, int32_t* out) {
+  int nt = static_cast<int>(std::thread::hardware_concurrency());
+  if (nt < 1) nt = 1;
+  if (nt > 16) nt = 16;
+  if (n < (1 << 16)) nt = 1;
+  std::vector<std::thread> threads;
+  auto work = [&](int t) {
+    int64_t lo = n * t / nt, hi = n * (t + 1) / nt;
+    for (int64_t i = lo; i < hi; ++i) {
+      double v = values[i];
+      if (std::isnan(v)) {
+        out[i] = nan_target;
+        continue;
+      }
+      int64_t b = std::lower_bound(bounds, bounds + nb, v) - bounds;
+      if (b >= nb) b = nb - 1;
+      out[i] = static_cast<int32_t>(b);
+    }
+  };
+  for (int t = 0; t < nt; ++t) threads.emplace_back(work, t);
+  for (auto& th : threads) th.join();
 }
 
 void fp_free(double* p) { std::free(p); }
