@@ -128,6 +128,9 @@ class GBDT:
     def __init__(self, config: Config, train_set: Optional[BinnedDataset]):
         import jax.numpy as jnp
 
+        from ._cache import ensure_compile_cache
+
+        ensure_compile_cache()
         self.config = config
         self.train_set = train_set
         self.objective: Optional[ObjectiveFunction] = create_objective(config)
@@ -153,6 +156,7 @@ class GBDT:
         self._stopped = False
         self._check_every = 50
         self._force_sync = False
+        self._force_sync_reason: Optional[str] = None
         self._init_iters = 0  # loaded iterations under continued training
 
         if train_set is None:
@@ -306,6 +310,9 @@ class GBDT:
                 # (is_feature_used_in_split_); the fused loop cannot see
                 # cross-iteration feature usage, so run synchronously
                 self._force_sync = True
+                self._force_sync_reason = (
+                    "coupled CEGB penalties track model-wide feature use"
+                )
         # forced splits (forcedsplits_filename, serial_tree_learner.cpp
         # ForceSplits): read the BFS plan once; leaf ids at application
         # time are precomputed (left child keeps the parent id, right
@@ -334,10 +341,12 @@ class GBDT:
             use_voting = False
         if config.tpu_debug_check_split:
             self._force_sync = True  # the check reads back per iteration
+            self._force_sync_reason = "tpu_debug_check_split reads back per iteration"
         if config.linear_tree:
             # leaf ridge fits run host-side per iteration (the reference
             # solves with Eigen on CPU too, linear_tree_learner.cpp:344)
             self._force_sync = True
+            self._force_sync_reason = "linear_tree leaf fits run on host"
             if train_set.raw_data is None:
                 log.fatal(
                     "linear_tree requires raw feature values; construct "
@@ -490,6 +499,9 @@ class GBDT:
                 # spanning non-addressable devices — ride the sync path
                 # (every jit takes the global arrays as arguments).
                 self._force_sync = True
+                self._force_sync_reason = (
+                    "multi-process runs synchronize per iteration"
+                )
                 if self.config.bagging_freq > 0 and \
                         self.config.bagging_fraction < 1.0:
                     log.warning(
@@ -1040,20 +1052,37 @@ class GBDT:
     # to "every chunk" because a single readback costs ~100ms on this
     # runtime.
     def fused_eligible(self) -> bool:
-        if self._force_sync or self.objective is None:
-            return False
+        return self.fused_ineligible_reason() is None
+
+    def fused_ineligible_reason(self) -> Optional[str]:
+        """None when the fused loop applies; otherwise a one-line reason
+        (surfaced by engine.train so users know WHY they are on the
+        slower per-iteration sync path)."""
+        if self._force_sync:
+            return (
+                self._force_sync_reason
+                or "this configuration requires the per-iteration sync loop"
+            )
+        if self.objective is None:
+            return "no built-in objective (custom fobj)"
         if not getattr(self.objective, "is_device_gradients", True):
-            return False
+            return f"objective {self.objective.name} computes host gradients"
         if getattr(self.objective, "has_host_state", False):
             # e.g. lambdarank position-bias factors: cross-iteration
             # host-held state the fused trace could not update
-            return False
+            return (
+                f"objective {self.objective.name} keeps cross-iteration "
+                "host state (e.g. position debiasing)"
+            )
         from .device_metrics import supported_names
 
         for ss in [self.train] + self.valids:
             if supported_names(ss.metrics) is None:
-                return False
-        return True
+                return (
+                    f"metric(s) {ss.metrics and [m.name for m in ss.metrics]}"
+                    " have no device implementation"
+                )
+        return None
 
     def _build_fused(self, track_train: bool):
         import jax
@@ -1761,6 +1790,7 @@ class DART(GBDT):
     def __init__(self, config: Config, train_set: Optional[BinnedDataset]):
         super().__init__(config, train_set)
         self._force_sync = True  # dropout mutates past trees every iter
+        self._force_sync_reason = "DART dropout mutates past trees every iteration"
         self._drop_rng = np.random.RandomState(config.drop_seed)
         self._tree_weight: List[float] = []  # per-iteration weights
         self._sum_weight = 0.0
@@ -1904,6 +1934,7 @@ class RF(GBDT):
                     )
         super().__init__(config, train_set)
         self._force_sync = True  # per-iter running-average score updates
+        self._force_sync_reason = "random forest averages scores per iteration"
         self.average_output = True
         self.shrinkage_rate = 1.0
         if train_set is None:
