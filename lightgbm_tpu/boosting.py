@@ -1615,6 +1615,31 @@ class GBDT:
                     out /= end - start_iteration
                 return out
         if early_stop is None:
+            # batch fast path: the native threaded walker does ~50M
+            # row-trees/s vs ~1.4M for the numpy level walk; linear-leaf
+            # trees keep the host path (per-leaf ridge outputs)
+            if (X.shape[0] > 256
+                    and not any(t.is_linear for t in self.models)):
+                from . import native
+
+                pm = self._packed_model()
+                if pm is not None:
+                    X = np.ascontiguousarray(X)  # once, not per class
+                    ok = True
+                    for k in range(K):
+                        idx = np.arange(start_iteration, end) * K + k
+                        res = native.predict_packed(
+                            pm, X, idx.astype(np.int32)
+                        )
+                        if res is None:
+                            ok = False
+                            break
+                        out[k] = res
+                    if ok:
+                        if self.average_output and end > start_iteration:
+                            out /= end - start_iteration
+                        return out
+                    out[:] = 0.0  # partial fill must not double-count
             for it in range(start_iteration, end):
                 for k in range(K):
                     out[k] += self.models[it * K + k].predict(X)
@@ -1640,6 +1665,20 @@ class GBDT:
         if self.average_output and end > start_iteration:
             out /= end - start_iteration
         return out
+
+    def _packed_model(self):
+        """Flat native-predictor arrays; rebuilt per call (packing is
+        ~ms against the walk it accelerates, and models mutate in place
+        through refit/set_leaf_output/rollback so caching would need
+        invalidation hooks at every mutation site)."""
+        try:
+            from . import native
+
+            if native.get_lib() is None:
+                return None
+            return native.PackedModel(self.models)
+        except Exception:  # noqa: BLE001 — fall back to the host walk
+            return None
 
     def predict(self, X, start_iteration=0, num_iteration=-1, raw_score=False,
                 early_stop=None):

@@ -108,6 +108,17 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
         ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
     ]
+    P = ctypes.POINTER
+    lib.fp_predict.restype = ctypes.c_int64
+    lib.fp_predict.argtypes = [
+        P(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+        P(ctypes.c_int32), ctypes.c_int64,
+        P(ctypes.c_int64), P(ctypes.c_int32), P(ctypes.c_double),
+        P(ctypes.c_int32), P(ctypes.c_int32), P(ctypes.c_int32),
+        P(ctypes.c_int64), P(ctypes.c_double),
+        P(ctypes.c_uint32), P(ctypes.c_int64), P(ctypes.c_int64),
+        P(ctypes.c_double),
+    ]
 
 
 def _take(lib, ptr, shape) -> np.ndarray:
@@ -168,6 +179,91 @@ def values_to_bins(values: np.ndarray, bounds: np.ndarray, nan_target: int
         bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         len(bounds), int(nan_target),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out
+
+
+class PackedModel:
+    """Flat tree arrays for fp_predict, built once per Booster model
+    state (reference SingleRowPredictor caching, c_api.cpp:66)."""
+
+    def __init__(self, trees) -> None:
+        n_nodes = [max(t.num_leaves - 1, 0) for t in trees]
+        off = np.zeros(len(trees) + 1, np.int64)
+        np.cumsum(n_nodes, out=off[1:])
+        loff = np.zeros(len(trees) + 1, np.int64)
+        np.cumsum([max(t.num_leaves, 1) for t in trees], out=loff[1:])
+        tot = int(off[-1])
+        self.node_off = off
+        self.leaf_off = loff
+        self.feature = np.zeros(tot, np.int32)
+        self.threshold = np.zeros(tot, np.float64)
+        self.dtype = np.zeros(tot, np.int32)
+        self.left = np.zeros(tot, np.int32)
+        self.right = np.zeros(tot, np.int32)
+        self.leaf_value = np.zeros(int(loff[-1]), np.float64)
+        catw_parts = []
+        self.cat_lo = np.zeros(tot, np.int64)
+        self.cat_hi = np.zeros(tot, np.int64)
+        wbase = 0
+        for ti, t in enumerate(trees):
+            a, b = int(off[ti]), int(off[ti + 1])
+            if b > a:
+                self.feature[a:b] = t.split_feature[: b - a]
+                self.threshold[a:b] = t.threshold[: b - a]
+                self.dtype[a:b] = np.asarray(
+                    t.decision_type[: b - a], np.int32
+                )
+                self.left[a:b] = t.left_child[: b - a]
+                self.right[a:b] = t.right_child[: b - a]
+                cb = np.asarray(t.cat_boundaries, np.int64)
+                words = np.asarray(t.cat_threshold, np.uint32)
+                if len(words):
+                    catw_parts.append(words)
+                cat_k = a + np.flatnonzero(self.dtype[a:b] & 1)
+                if len(cat_k):
+                    ci = self.threshold[cat_k].astype(np.int64)
+                    self.cat_lo[cat_k] = wbase + cb[ci]
+                    self.cat_hi[cat_k] = wbase + cb[ci + 1]
+                wbase += len(words)
+            la = int(loff[ti])
+            lv = np.asarray(t.leaf_value, np.float64)
+            self.leaf_value[la : la + len(lv)] = lv
+        self.catw = (
+            np.concatenate(catw_parts).astype(np.uint32)
+            if catw_parts else np.zeros(1, np.uint32)
+        )
+        # widest feature referenced: callers must verify X has more
+        # columns (the numpy walk raises IndexError; the C side would
+        # read out of bounds)
+        self.max_feature = int(self.feature.max()) if tot else -1
+
+
+def predict_packed(pm: "PackedModel", X: np.ndarray,
+                   tree_idx: np.ndarray) -> Optional[np.ndarray]:
+    """Sum of leaf outputs of `tree_idx` trees per row; None when the
+    native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if X.shape[1] <= pm.max_feature:
+        return None  # host walk raises the proper IndexError
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    tree_idx = np.ascontiguousarray(tree_idx, dtype=np.int32)
+    out = np.empty(X.shape[0], np.float64)
+
+    def p(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    lib.fp_predict(
+        p(X, ctypes.c_double), X.shape[0], X.shape[1],
+        p(tree_idx, ctypes.c_int32), len(tree_idx),
+        p(pm.node_off, ctypes.c_int64), p(pm.feature, ctypes.c_int32),
+        p(pm.threshold, ctypes.c_double), p(pm.dtype, ctypes.c_int32),
+        p(pm.left, ctypes.c_int32), p(pm.right, ctypes.c_int32),
+        p(pm.leaf_off, ctypes.c_int64), p(pm.leaf_value, ctypes.c_double),
+        p(pm.catw, ctypes.c_uint32), p(pm.cat_lo, ctypes.c_int64),
+        p(pm.cat_hi, ctypes.c_int64), p(out, ctypes.c_double),
     )
     return out
 
