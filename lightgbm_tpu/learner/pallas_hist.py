@@ -5,23 +5,23 @@ The histogram is the reference's single hottest loop
 atomics in src/treelearner/cuda/cuda_histogram_constructor.cu on CUDA).
 A TPU has no vector scatter, so the kernel reformulates scatter-add as
 a one-hot contraction — but unlike a plain XLA einsum, the one-hot
-matrix only ever exists one (HIST_BLK, B) tile at a time in VMEM,
+matrix only ever exists one (B, HIST_BLK) tile at a time in VMEM,
 never in HBM. Per grid step (one row block):
 
     bins tile (F, blk) int32, gh tile (8, blk) f32    -> VMEM
-    bt = transpose(bins tile)                          (blk, F), one relayout
     for each feature f (static unroll):
-        onehot = (bt[:, f:f+1] == iota_B)              (blk, B) bf16
-        acc[:, f*B:(f+1)*B] += gh @ onehot             MXU (8,blk)@(blk,B)
-    last step: out = acc
+        ohT = (bins[f:f+1, :] == iota_B^T)             (B, blk) bf16
+        out[:, f*B:(f+1)*B] += gh . ohT^T              MXU NT dot_general
 
 Inputs are feature-major (rows on the LANE axis) because TPU memory
 tiles pad the minor-most dim to 128 lanes — a row-major (N, 28) matrix
-would physically occupy 4.5x its size in HBM. One in-kernel transpose
-per tile puts rows on sublanes for the one-hot compare. The channel
-axis is padded 3 -> 8 (bf16x2-split grad/hess + count, see
-histogram.build_gh8) to match the f32 sublane tile; f32 accumulation
-into a (8, F*B) VMEM scratch across grid steps.
+would physically occupy 4.5x its size in HBM. The one-hot is built
+TRANSPOSED in that same layout and contracted with an NT dot_general;
+an earlier version transposed the bins tile per block, which cost
+~2 ms/pass and serialized against the int8 MXU stream (1.75x on the
+quantized path). The channel axis is padded 3 -> 8 (bf16x2-split
+grad/hess + count, see histogram.build_gh8) to match the f32 sublane
+tile; accumulation rides the grid-constant output block.
 """
 
 from __future__ import annotations
@@ -35,6 +35,24 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .histogram import CH, HIST_BLK, NAT_CH
+
+
+def _accum_hist_nt(bins_ref, lhs, out_ref, *, F, B, blk, dt, acc_t):
+    """Shared accumulate loop: one NT matmul per feature, the one-hot
+    built TRANSPOSED (B, blk) directly from the bins tile's native
+    (F, blk) layout — the former per-block (blk, F) int32 transpose
+    cost ~2 ms/pass at 1M rows and serialized against the int8 MXU
+    stream. Grouping features into wider matmuls was tried and measured
+    SLOWER (lane-axis concat of one-hots cost more than the larger
+    matmul saved: 4.75 -> 3.71 trees/s end to end; 3D->2D reshapes onto
+    the lane axis don't lower in Mosaic at all)."""
+    iota_bT = lax.broadcasted_iota(jnp.int32, (B, blk), 0)
+    for f in range(F):
+        ohT = (bins_ref[f : f + 1, :] == iota_bT).astype(dt)  # (B, blk)
+        out_ref[:, f * B : (f + 1) * B] += lax.dot_general(
+            lhs, ohT, (((1,), (1,)), ((), ())),
+            preferred_element_type=acc_t,
+        )
 
 
 def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref,
@@ -83,18 +101,8 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref,
         g5 = gh[:nat_ch, :].astype(dt)  # (nat_ch, blk)
         W = (sl[:, None, :] * g5[None, :, :]).reshape(S * nat_ch, blk)
 
-    bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
-    # one (M, blk) @ (blk, B) matmul per feature. Grouping features into
-    # wider matmuls was tried and measured SLOWER (lane-axis concat of
-    # one-hots cost more than the larger matmul saved: 4.75 -> 3.71
-    # trees/s end to end; 3D->2D reshapes onto the lane axis don't
-    # lower in Mosaic at all)
-    iota_b = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
-    for f in range(F):
-        onehot = (bt[:, f : f + 1] == iota_b).astype(dt)  # (blk, B)
-        out_ref[:, f * B : (f + 1) * B] += jnp.dot(
-            W, onehot, preferred_element_type=acc_t
-        )
+    _accum_hist_nt(bins_ref, W, out_ref, F=F, B=B, blk=blk, dt=dt,
+                   acc_t=acc_t)
 
 
 @functools.partial(
@@ -242,14 +250,9 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, *, F: int, B: int, blk: int):
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
     g = gh_ref[...].astype(jnp.bfloat16)  # (CH, blk)
-    iota = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
-    for f in range(F):
-        onehot = (bt[:, f : f + 1] == iota).astype(jnp.bfloat16)  # (blk, B)
-        out_ref[:, f * B : (f + 1) * B] += jnp.dot(
-            g, onehot, preferred_element_type=jnp.float32
-        )
+    _accum_hist_nt(bins_ref, g, out_ref, F=F, B=B, blk=blk,
+                   dt=jnp.bfloat16, acc_t=jnp.float32)
 
 
 def _hist_slots_kernel(
@@ -273,13 +276,8 @@ def _hist_slots_kernel(
     g = jnp.where((iota_r >= lo) & (iota_r < hi), gh_ref[...], 0.0).astype(
         jnp.bfloat16
     )
-    bt = jnp.transpose(bins_ref[...])  # (blk, F) int32
-    iota = lax.broadcasted_iota(jnp.int32, (blk, B), 1)
-    for f in range(F):
-        onehot = (bt[:, f : f + 1] == iota).astype(jnp.bfloat16)  # (blk, B)
-        acc_ref[:, f * B : (f + 1) * B] += jnp.dot(
-            g, onehot, preferred_element_type=jnp.float32
-        )
+    _accum_hist_nt(bins_ref, g, acc_ref, F=F, B=B, blk=blk,
+                   dt=jnp.bfloat16, acc_t=jnp.float32)
 
     # vslot has a trailing sentinel, so v+1 is always readable
     @pl.when(vslot_ref[v + 1] != slot)
