@@ -1,0 +1,97 @@
+"""Native batch predictor (native/fastparse.cpp fp_predict) parity.
+
+The threaded C++ walker must be BIT-identical to the numpy level walk
+(tree.py predict_leaf) — categoricals, NaN routing, missing types,
+stumps — and preserve the host path's error semantics for malformed
+input. Mirrors the reference's expectation that all predictors agree
+(src/io/tree.h Tree::Predict is the single source of truth there)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import native
+
+
+def _fit(X, y, **params):
+    ds = lgb.Dataset(
+        X, label=y, free_raw_data=False,
+        categorical_feature=params.pop("categorical_feature", None),
+    )
+    p = dict(objective="binary", num_leaves=31, verbosity=-1,
+             min_data_in_leaf=5)
+    p.update(params)
+    return lgb.train(p, ds, num_boost_round=12)
+
+
+@pytest.mark.skipif(native.get_lib() is None, reason="no native toolchain")
+def test_native_predict_bit_identical_with_cat_and_nan():
+    rs = np.random.RandomState(0)
+    Xt = rs.randn(3000, 10)
+    Xt[:, 4] = rs.randint(0, 20, 3000)
+    Xt[rs.rand(3000) < 0.05, 2] = np.nan
+    y = (np.nan_to_num(Xt[:, 0]) + (Xt[:, 4] % 3 == 0) > 0).astype(float)
+    bst = _fit(Xt, y, categorical_feature=[4])
+
+    X = rs.randn(20_000, 10)
+    X[:, 4] = rs.randint(-3, 30, 20_000)  # incl. unseen/negative cats
+    X[rs.rand(20_000) < 0.05, 2] = np.nan
+    p_native = bst.predict(X)  # batch > 256 rows -> native path
+    real = native.predict_packed
+    native.predict_packed = lambda *a, **k: None
+    try:
+        p_host = bst.predict(X)
+    finally:
+        native.predict_packed = real
+    np.testing.assert_array_equal(p_native, p_host)
+
+
+@pytest.mark.skipif(native.get_lib() is None, reason="no native toolchain")
+def test_native_predict_narrow_input_raises():
+    rs = np.random.RandomState(1)
+    X = rs.randn(1000, 8)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _fit(X, y)
+    with pytest.raises(IndexError):
+        bst.predict(rs.randn(2000, 3))
+
+
+@pytest.mark.skipif(native.get_lib() is None, reason="no native toolchain")
+def test_native_predict_multiclass_noncontiguous():
+    rs = np.random.RandomState(2)
+    X = rs.randn(1500, 6)
+    y = rs.randint(0, 3, 1500).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbosity": -1}, ds,
+                    num_boost_round=6)
+    Xf = np.asfortranarray(rs.randn(5000, 6))
+    p = bst.predict(Xf)
+    assert p.shape == (5000, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_native_binning_bit_exact_vs_python():
+    """greedy_find_bin native/python parity on spiky distributions
+    (both mirror reference bin.cpp:80 in double arithmetic)."""
+    if native.get_lib() is None:
+        pytest.skip("no native toolchain")
+    from lightgbm_tpu import binning as B
+
+    rs = np.random.RandomState(3)
+    for trial in range(10):
+        dv = np.unique(rs.randn(rs.randint(600, 20000)) * 50)
+        cnt = rs.randint(1, 40, len(dv)).astype(np.int64)
+        cnt[rs.randint(0, len(cnt), 2)] = rs.randint(5000, 500000)
+        total = int(cnt.sum())
+        mb = int(rs.choice([15, 63, 255]))
+        mdib = int(rs.choice([1, 3, 20]))
+        real = native.greedy_find_bin
+        native.greedy_find_bin = lambda *a, **k: None
+        try:
+            py = B.greedy_find_bin(dv, cnt, mb, total, mdib)
+        finally:
+            native.greedy_find_bin = real
+        nat = B.greedy_find_bin(dv, cnt, mb, total, mdib)
+        assert len(py) == len(nat), trial
+        np.testing.assert_array_equal(np.array(py), np.array(nat))
