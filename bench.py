@@ -272,6 +272,8 @@ def main() -> None:
         "verbosity": -1,
         "tpu_growth_mode": growth_mode,
     }
+    if os.environ.get("BENCH_SLOTS"):
+        params["tpu_round_slots"] = int(os.environ["BENCH_SLOTS"])
     if os.environ.get("BENCH_QUANT"):
         # quantized-gradient training (use_quantized_grad): int8 MXU
         # histograms, 48 slots/pass — the reference's quantized mode

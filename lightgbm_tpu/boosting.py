@@ -441,11 +441,10 @@ class GBDT:
             rounds=(config.tpu_growth_rounds and not use_rounds
                     and rounds_ok),
             # slot defaults are chip-tuned END TO END (BENCH_NOTES r4):
-            # quant ch3 S=48 (0.258 ms/split) beat 42; non-quant S=32
-            # measured SLOWER than 25 end to end (4.39 vs 4.75 trees/s
-            # — the wider pass wastes width on candidate-limited
-            # rounds) so 25 stays; larger S fails the scoped-VMEM
-            # compile (ch5 >32, ch3 >48)
+            # quant ch3 S=48 beat both 42 (0.258 vs 0.302 ms/split) and
+            # 64 (10.06 vs 9.83 trees/s); non-quant S=32 measured
+            # SLOWER than 25 end to end (4.39 vs 4.75 — wider passes
+            # waste width on candidate-limited rounds) so 25 stays
             rounds_slots=(
                 min(config.tpu_round_slots
                     or (48 if config.use_quantized_grad else 25),

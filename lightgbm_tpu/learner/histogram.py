@@ -226,16 +226,19 @@ def hist_nat_slots(
     nat_ch = 3 if quant else NAT_CH
     # VMEM guard: chunk the slot axis so the kernel's grid-constant
     # output block stays within the scoped budget. Chip-calibrated
-    # (BENCH_NOTES r4): ch5 S=32 (4.59MB out) and ch3 S=48 (3.94MB)
-    # compile; ch5 S=36 and ch3 S=56 fail — the W tile, per-feature
-    # one-hots and double-buffered inputs cost roughly 2x the output
-    # block again. The byte formula guards wide feature sets; the
-    # empirical per-channel-count cap guards the slot axis.
+    # compile limits, post-NT-kernel (BENCH_NOTES r4): ch5 S=32
+    # compiles / S=36 fails; ch3 S=64 compiles (6.06 ms; the pre-NT
+    # kernel failed past 48 — removing the in-kernel transpose freed
+    # scoped stack). The W tile, per-feature one-hots and
+    # double-buffered inputs cost roughly 2x the output block again.
+    # The byte formula guards wide feature sets; the empirical
+    # per-channel-count cap guards the slot axis.
     per_slot = nat_ch * F * num_bins * 4
-    s_cap = 32 if nat_ch >= 5 else 48
-    s_max = max(1, min(int(4.6 * 2 ** 20) // max(per_slot, 1), s_cap))
+    s_cap, budget = (32, int(4.6 * 2 ** 20)) if nat_ch >= 5 \
+        else (64, int(5.7 * 2 ** 20))
+    s_max = max(1, min(budget // max(per_slot, 1), s_cap))
     if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
-            and per_slot <= int(4.6 * 2 ** 20)):
+            and per_slot <= budget):
         from .pallas_hist import hist_nat_tpu
 
         parts = []
