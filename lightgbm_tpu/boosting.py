@@ -456,9 +456,13 @@ class GBDT:
             quant=bool(use_rounds and config.use_quantized_grad
                        and config.num_grad_quant_bins <= 256),
             # levels within int8 range (g <= bins/2, h <= bins): the
-            # kernel runs s8 x s8 -> s32 on the MXU
+            # kernel runs s8 x s8 -> s32 on the MXU. rounds.py further
+            # gates on histogram.int8_oh_shift finding a SWAR scale
+            # whose worst-case s32 cell sum cannot overflow (ADVICE r4)
             quant_int8=bool(use_rounds and config.use_quantized_grad
                             and config.num_grad_quant_bins <= 127),
+            quant_levels=(config.num_grad_quant_bins
+                          if config.use_quantized_grad else 0),
             mono_mode=mono_mode,
             voting_k=config.top_k if use_voting else 0,
             extra_trees=use_extra,

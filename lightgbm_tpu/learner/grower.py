@@ -125,6 +125,9 @@ class GrowerSpec(NamedTuple):
     # kernel runs s8 x s8 -> s32 on the MXU — twice the bf16 rate on v5e
     # and bit-exact integer sums (bin.h:63-81 int histogram analog)
     quant_int8: bool = False
+    # num_grad_quant_bins when quant: bounds the per-cell integer sums
+    # for the SWAR one-hot scale policy (histogram.int8_oh_shift)
+    quant_levels: int = 0
     # monotone constraint method (monotone_constraints_method):
     # 0 = basic (children bounded at the split midpoint, inherited);
     # 1 = intermediate/advanced (monotone_constraints.hpp:516): per-leaf

@@ -210,6 +210,7 @@ def hist_nat_slots(
     num_bins: int,
     quant: bool = False,  # gh8 built by build_gh8_quant (3 channels)
     int8: bool = False,  # quant levels within +/-127: s8 MXU, s32 sums
+    oh_shift: int = 0,  # SWAR one-hot scale (int8_oh_shift policy)
 ) -> jax.Array:
     """Per-slot histograms keyed by a row->slot vector -> (S, 3, F, B).
 
@@ -234,8 +235,7 @@ def hist_nat_slots(
     # The byte formula guards wide feature sets; the empirical
     # per-channel-count cap guards the slot axis.
     per_slot = nat_ch * F * num_bins * 4
-    s_cap, budget = (32, int(4.6 * 2 ** 20)) if nat_ch >= 5 \
-        else (64, int(5.7 * 2 ** 20))
+    s_cap, budget = _round_caps(nat_ch)
     s_max = max(1, min(budget // max(per_slot, 1), s_cap))
     if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
             and per_slot <= budget):
@@ -252,7 +252,7 @@ def hist_nat_slots(
             out = hist_nat_tpu(
                 bins_fm, gh8, local, sc, num_bins,
                 interpret=_interpret_pallas(), nat_ch=nat_ch,
-                int8=bool(int8 and quant),
+                int8=bool(int8 and quant), oh_shift=oh_shift,
             )  # (sc*nat_ch, F*B)
             o = out.reshape(sc, nat_ch, F, num_bins)
             if quant:
@@ -264,6 +264,82 @@ def hist_nat_slots(
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     return _hist_nat_fallback(bins_fm, gh8, slot, num_slots, num_bins,
                               quant=quant)
+
+
+def int8_oh_shift(n_rows: int, quant_levels: int) -> Optional[int]:
+    """SWAR one-hot scale policy for the int8 histogram path.
+
+    The SWAR one-hot bytes carry value 128 >> shift, so a histogram
+    cell's s32 accumulator sees sums up to n_rows * level * (128 >>
+    shift). Pick the cheapest shift (0 is ~2 VPU ops/vector cheaper
+    than 4; 7 yields exact 1s like the compare path) that keeps the
+    worst case under 2^31; None means even unscaled sums can overflow
+    and the caller must not use int8 at all (ADVICE r4: a near-constant
+    feature on a >16M-row dataset at max levels wraps silently — the
+    reference's int32 buffers have the same bound, bin.h:63-81)."""
+    levels = max(int(quant_levels), 1)
+    for shift in (0, 4, 7):
+        if n_rows * levels * (128 >> shift) < 2 ** 31:
+            return shift
+    return None
+
+
+def _round_caps(nat_ch: int) -> tuple:
+    """(slot cap, scoped-VMEM budget) for the slot-packed kernels —
+    chip-calibrated compile limits shared by hist_nat_slots and the
+    fused round kernel (see the comment in hist_nat_slots)."""
+    return (32, int(4.6 * 2 ** 20)) if nat_ch >= 5 \
+        else (64, int(5.7 * 2 ** 20))
+
+
+def can_hist_round(n_rows: int, num_slots: int, num_feat: int,
+                   num_bins: int, quant: bool) -> bool:
+    """Static gate for the fused round kernel (pallas path only, no
+    slot chunking — the partition decision must see every slot)."""
+    nat_ch = 3 if quant else NAT_CH
+    s_cap, budget = _round_caps(nat_ch)
+    per_slot = nat_ch * num_feat * num_bins * 4
+    return (
+        _use_pallas()
+        and n_rows % HIST_BLK == 0
+        and n_rows >= HIST_BLK
+        and per_slot <= budget  # one slot must fit the scoped VMEM
+        and num_slots <= max(1, min(budget // max(per_slot, 1), s_cap))
+    )
+
+
+def hist_round(
+    bins_fm: jax.Array,  # (F, N) int32
+    gh8: jax.Array,  # (CH, N) f32
+    pleaf: jax.Array,  # (N,) int32 row -> leaf
+    params: jax.Array,  # (S, 16) int32 per-slot split params
+    col_onehot: jax.Array,  # (S, F) f32
+    num_slots: int,
+    num_bins: int,
+    quant: bool = False,
+    int8: bool = False,
+    oh_shift: int = 0,
+    efb: bool = False,
+):
+    """Fused round step -> ((S, 3, F, B) f32 histograms, (N,) new
+    row->leaf). Callers must check can_hist_round first; histogram
+    sums are exact (integer s32 on the int8 path, rescaled here)."""
+    from .pallas_hist import hist_round_tpu, _swar_divisor
+
+    F, N = bins_fm.shape
+    nat_ch = 3 if quant else NAT_CH
+    out, pl_new = hist_round_tpu(
+        bins_fm, gh8, pleaf, params, col_onehot, num_slots, num_bins,
+        nat_ch, int8=bool(int8 and quant), oh_shift=oh_shift, efb=efb,
+        interpret=_interpret_pallas(),
+    )
+    if int8 and quant:
+        out = out.astype(jnp.float32) * (1.0 / _swar_divisor(oh_shift))
+    o = out.reshape(num_slots, nat_ch, F, num_bins)
+    if quant:
+        return o, pl_new
+    o3 = jnp.stack([o[:, 0] + o[:, 1], o[:, 2] + o[:, 3], o[:, 4]], axis=1)
+    return o3, pl_new
 
 
 def take_cols(tab: jax.Array, idx: jax.Array) -> jax.Array:

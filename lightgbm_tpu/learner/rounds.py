@@ -44,8 +44,11 @@ from .bundle import BundleInfo, decode_feature_bins, expand_hist
 from .histogram import (
     build_gh8,
     build_gh8_quant,
+    can_hist_round,
     hist_nat_slots,
+    hist_round,
     histogram,
+    int8_oh_shift,
     root_sums,
 )
 from .grower import (
@@ -61,6 +64,10 @@ from .split import NEG_INF, BIG, SplitParams, SplitRecord, best_split, leaf_outp
 
 class _NState(NamedTuple):
     i: jax.Array  # splits performed so far
+    r: jax.Array  # (W+1,) int32 — rounds executed, by ladder width
+    # (r[w] = rounds run at widths[w]; r[-1] = total). Scalar counters,
+    # free at runtime; surfaced by grow_tree_rounds(..., with_stats=True)
+    # for profiling the ladder on real gain landscapes.
     pleaf: jax.Array  # (N,) int32 row -> leaf; invalid rows carry L
     hist: jax.Array  # (L, 3, G, Bc) histogram pool
     leaf_g: jax.Array
@@ -73,7 +80,7 @@ class _NState(NamedTuple):
     tree: TreeArrays
 
 
-@partial(jax.jit, static_argnames=("spec",))
+@partial(jax.jit, static_argnames=("spec", "with_stats"))
 def grow_tree_rounds(
     bins_fm: jax.Array,  # (G, N) int32, natural row order
     nan_bin: jax.Array,
@@ -89,8 +96,10 @@ def grow_tree_rounds(
     valid: Optional[jax.Array] = None,
     bundle: Optional[BundleInfo] = None,
     gh_scale: Optional[jax.Array] = None,  # (2,) [g_scale, h_scale]
-) -> Tuple[TreeArrays, jax.Array]:
-    """Grow one tree; returns (tree arrays, natural-order row->leaf).
+    with_stats: bool = False,  # also return per-width round counters
+):
+    """Grow one tree; returns (tree arrays, natural-order row->leaf),
+    plus a {"widths", "rounds"} stats dict when with_stats=True.
 
     With spec.quant, grad/hess are INTEGER quantization levels and
     gh_scale carries the per-iteration dequantization scales: histogram
@@ -102,7 +111,7 @@ def grow_tree_rounds(
     B = spec.num_bins
     G, N = bins_fm.shape  # G = device columns (bundles when spec.efb)
     F = num_bins.shape[0]
-    S = spec.rounds_slots
+    S = min(spec.rounds_slots, max(L - 1, 1))  # top_k needs k <= L
     ax = spec.axis_name
     Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
     if spec.voting_k:
@@ -114,6 +123,21 @@ def grow_tree_rounds(
         )
     if spec.quant and gh_scale is None:
         raise ValueError("spec.quant requires gh_scale (level scales)")
+
+    # SWAR one-hot scale for the int8 kernels (histogram.int8_oh_shift);
+    # int8 itself is gated on the policy finding ANY safe shift
+    oh_shift = int8_oh_shift(N, spec.quant_levels) if spec.quant_int8 else 0
+    use_int8 = bool(spec.quant_int8 and oh_shift is not None)
+    oh_shift = oh_shift or 0
+    # fused partition+histogram kernel (VERDICT r4 item 2): one pass
+    # computes the slot-packed child histograms AND the new row->leaf
+    # vector; the separate (G, N) split-column select, membership
+    # matmul and partition update disappear. Categorical splits still
+    # ride the explicit path (the category-set test needs the (S, B)
+    # mask contraction).
+    use_fused = (not spec.has_cat) and can_hist_round(
+        N, S, G, Bc, spec.quant
+    )
 
     def exp_hist(h, g_sum, h_sum, c_sum):
         if spec.efb:
@@ -132,7 +156,7 @@ def grow_tree_rounds(
         root = root * scale3
         hist0 = hist_nat_slots(
             bins_fm, gh8, jnp.zeros(N, jnp.int32), 1, Bc, quant=True,
-            int8=spec.quant_int8,
+            int8=use_int8, oh_shift=oh_shift,
         )[0]
         if ax is not None:
             hist0 = lax.psum(hist0, ax)
@@ -174,11 +198,34 @@ def grow_tree_rounds(
 
     valid_f = jnp.ones(N, jnp.float32) if valid is None else valid
     iota_L = jnp.arange(L, dtype=jnp.int32)
-    iota_S = jnp.arange(S, dtype=jnp.int32)
+
+    # ---- S-ladder: early rounds are candidate-limited (1, 2, 4, ...
+    # leaves have positive gain), yet the slot-packed kernel's matmul
+    # costs M = S x channels rows REGARDLESS of how many slots are
+    # live — a full-width S=48 pass for a 1-candidate round wastes
+    # ~4 ms of MXU time. The while body therefore switches between
+    # narrow/mid/full kernel widths by live candidate count. Selection
+    # is unchanged (top-k of a wider k picks the same set), so the
+    # grown tree is bit-identical to the single-width formulation.
+    widths = tuple(w for w in (8, 32) if w < S) + (S,)
 
     def body(s: _NState) -> _NState:
+        budget0 = (L - 1) - s.i
+        n_pos = jnp.sum(s.best.gain > 0.0).astype(jnp.int32)
+        n_cand = jnp.minimum(budget0, n_pos)
+        bidx = jnp.sum(
+            n_cand > jnp.asarray(widths[:-1], jnp.int32)
+        ).astype(jnp.int32)
+        s = s._replace(r=s.r.at[bidx].add(1).at[-1].add(1))
+        return lax.switch(
+            bidx, [partial(round_step, Sk=w) for w in widths], s
+        )
+
+    def round_step(s: _NState, Sk: int) -> _NState:
         t = s.tree
         i = s.i
+        S = Sk  # kernel width for this round (see the ladder above)
+        iota_S = jnp.arange(S, dtype=jnp.int32)
 
         # ---- select this round's splits: top-k by gain within budget.
         # depth limits were already folded into best.gain when the
@@ -262,6 +309,8 @@ def grow_tree_rounds(
         # which exceed bf16's exact-integer range (256) on wide or
         # deep-binned datasets; f32 is exact to 2^24 and the (N,S)@(S,9)
         # matmul is far too small for the precision to cost wall time.
+        # On the fused-kernel path all of this happens INSIDE the
+        # histogram pass (pallas_hist._round_kernel) — see use_fused.
         left_smaller = rec.left_c <= rec.right_c  # (L,) — GLOBAL counts,
         # shard-consistent under data parallelism (derived from the
         # psum'd parent histogram during split search)
@@ -270,77 +319,114 @@ def grow_tree_rounds(
         feat_s = rec.feature[sl_i]  # (S,) tiny gathers from (L,) tables
         col_s = bundle.bundle_of[feat_s] if spec.efb else feat_s
         nan_s = nan_bin[feat_s]
-        pack_cols = [
-            col_s.astype(jnp.float32),  # 0: device bin column
-            rec.bin[sl_i].astype(jnp.float32),  # 1: threshold bin
-            rec.default_left[sl_i].astype(jnp.float32),  # 2
-            rec.is_cat[sl_i].astype(jnp.float32),  # 3
-            nan_s.astype(jnp.float32),  # 4: NaN bin (-1 = none)
-            iota_S.astype(jnp.float32),  # 5: slot rank
-            left_smaller[sl_i].astype(jnp.float32),  # 6
-            jnp.ones(S, jnp.float32),  # 7: membership indicator
-            feat_s.astype(jnp.float32),  # 8: true feature id (EFB decode)
-        ]
-        pack = jnp.stack(pack_cols, axis=1) * live[:, None]  # (S, 9) f32
-        memb = (s.pleaf[:, None] == sel_leaf[None, :])  # (N, S) one-hot
-        # HIGHEST precision: the default TPU matmul multiplies f32 in
-        # bf16, which would corrupt packed ids above 256 — the exact
-        # case the f32 pack exists for
-        vals = lax.dot_general(
-            memb.astype(jnp.float32), pack, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=lax.Precision.HIGHEST,
-        )  # (N, 9); rows outside every selected leaf are all-zero
-        in_split = vals[:, 7] > 0.5
-        col_row = vals[:, 0].astype(jnp.int32)
-        bin_row = vals[:, 1].astype(jnp.int32)
-        dl_row = vals[:, 2] > 0.5
-        cat_row = vals[:, 3] > 0.5
-        nan_row = vals[:, 4].astype(jnp.int32)
-        rank_row = vals[:, 5].astype(jnp.int32)
-        small_row = vals[:, 6] > 0.5
-        # masked select of each row's split column (no 2D gather)
-        col_sel = col_row[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]
-        fbins = jnp.sum(jnp.where(col_sel, bins_fm, 0), axis=0)
-        if spec.efb:
-            f_row = vals[:, 8].astype(jnp.int32)
-            fbins = decode_feature_bins(fbins, f_row, bundle)
-        if spec.has_cat:
-            # category-set membership as a bin-one-hot contraction:
-            # hit[r] = cat_mask[slot(r), fbins[r]] without the (L*B,)
-            # flat gather
-            ob = (fbins[:, None] == jnp.arange(B, dtype=jnp.int32)[None, :])
-            cm_sel = (rec.cat_mask[sl_i].astype(jnp.bfloat16)
-                      * live[:, None])  # (S, B)
-            hits = lax.dot_general(
-                ob.astype(jnp.bfloat16), cm_sel,
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # (N, S)
-            cat_hit = jnp.sum(hits * memb, axis=1) > 0.5
-        else:
-            cat_hit = jnp.zeros_like(in_split)
-        go_left = jnp.where(
-            cat_row,
-            cat_hit,
-            (fbins <= bin_row)
-            | (dl_row & (fbins == nan_row) & (nan_row >= 0)),
-        )
-        pleaf_new = jnp.where(
-            in_split & ~go_left, i + 1 + rank_row, s.pleaf
-        ).astype(jnp.int32)
+        new_id_s = jnp.where(take, i + 1 + iota_S, L)
 
-        # ---- smaller-child histograms: one slot-packed pass ----
-        go_small = go_left == small_row
-        hslot = jnp.where(in_split & go_small, rank_row, S).astype(jnp.int32)
-        slot_hists = hist_nat_slots(
-            bins_fm, gh8, hslot, S, Bc, quant=spec.quant,
-            int8=spec.quant_int8,
-        )  # (S, 3, G, Bc)
-        if ax is not None:
-            slot_hists = lax.psum(slot_hists, ax)
-        if spec.quant:
-            slot_hists = slot_hists * scale3[:, None, None]
+        if use_fused:
+            zs = jnp.zeros(S, jnp.int32)
+            if spec.efb:
+                efb_cols = [bundle.off_lo[feat_s], bundle.mfb[feat_s],
+                            bundle.width[feat_s]]
+            else:
+                efb_cols = [zs, jnp.full(S, -1, jnp.int32), zs]
+            params16 = jnp.stack(
+                [
+                    sel_leaf, col_s,
+                    rec.bin[sl_i],
+                    rec.default_left[sl_i].astype(jnp.int32),
+                    nan_s,
+                    left_smaller[sl_i].astype(jnp.int32),
+                    new_id_s,
+                ] + efb_cols + [zs] * 6,
+                axis=1,
+            ).astype(jnp.int32)  # (S, 16)
+            coh = (
+                col_s[:, None] == jnp.arange(G, dtype=jnp.int32)[None, :]
+            ).astype(jnp.float32)  # (S, G)
+            slot_hists, pleaf_new = hist_round(
+                bins_fm, gh8, s.pleaf, params16, coh, S, Bc,
+                quant=spec.quant, int8=use_int8, oh_shift=oh_shift,
+                efb=spec.efb,
+            )
+            if ax is not None:
+                slot_hists = lax.psum(slot_hists, ax)
+            if spec.quant:
+                slot_hists = slot_hists * scale3[:, None, None]
+        else:
+            pack_cols = [
+                col_s.astype(jnp.float32),  # 0: device bin column
+                rec.bin[sl_i].astype(jnp.float32),  # 1: threshold bin
+                rec.default_left[sl_i].astype(jnp.float32),  # 2
+                rec.is_cat[sl_i].astype(jnp.float32),  # 3
+                nan_s.astype(jnp.float32),  # 4: NaN bin (-1 = none)
+                iota_S.astype(jnp.float32),  # 5: slot rank
+                left_smaller[sl_i].astype(jnp.float32),  # 6
+                jnp.ones(S, jnp.float32),  # 7: membership indicator
+                feat_s.astype(jnp.float32),  # 8: true feature id (EFB)
+            ]
+            pack = jnp.stack(pack_cols, axis=1) * live[:, None]  # (S, 9)
+            memb = (s.pleaf[:, None] == sel_leaf[None, :])  # (N, S)
+            # HIGHEST precision: the default TPU matmul multiplies f32
+            # in bf16, which would corrupt packed ids above 256 — the
+            # exact case the f32 pack exists for
+            vals = lax.dot_general(
+                memb.astype(jnp.float32), pack, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST,
+            )  # (N, 9); rows outside every selected leaf are all-zero
+            in_split = vals[:, 7] > 0.5
+            col_row = vals[:, 0].astype(jnp.int32)
+            bin_row = vals[:, 1].astype(jnp.int32)
+            dl_row = vals[:, 2] > 0.5
+            cat_row = vals[:, 3] > 0.5
+            nan_row = vals[:, 4].astype(jnp.int32)
+            rank_row = vals[:, 5].astype(jnp.int32)
+            small_row = vals[:, 6] > 0.5
+            # masked select of each row's split column (no 2D gather)
+            col_sel = (col_row[None, :]
+                       == jnp.arange(G, dtype=jnp.int32)[:, None])
+            fbins = jnp.sum(jnp.where(col_sel, bins_fm, 0), axis=0)
+            if spec.efb:
+                f_row = vals[:, 8].astype(jnp.int32)
+                fbins = decode_feature_bins(fbins, f_row, bundle)
+            if spec.has_cat:
+                # category-set membership as a bin-one-hot contraction:
+                # hit[r] = cat_mask[slot(r), fbins[r]] without the
+                # (L*B,) flat gather
+                ob = (fbins[:, None]
+                      == jnp.arange(B, dtype=jnp.int32)[None, :])
+                cm_sel = (rec.cat_mask[sl_i].astype(jnp.bfloat16)
+                          * live[:, None])  # (S, B)
+                hits = lax.dot_general(
+                    ob.astype(jnp.bfloat16), cm_sel,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # (N, S)
+                cat_hit = jnp.sum(hits * memb, axis=1) > 0.5
+            else:
+                cat_hit = jnp.zeros_like(in_split)
+            go_left = jnp.where(
+                cat_row,
+                cat_hit,
+                (fbins <= bin_row)
+                | (dl_row & (fbins == nan_row) & (nan_row >= 0)),
+            )
+            pleaf_new = jnp.where(
+                in_split & ~go_left, i + 1 + rank_row, s.pleaf
+            ).astype(jnp.int32)
+
+            # ---- smaller-child histograms: one slot-packed pass ----
+            go_small = go_left == small_row
+            hslot = jnp.where(
+                in_split & go_small, rank_row, S
+            ).astype(jnp.int32)
+            slot_hists = hist_nat_slots(
+                bins_fm, gh8, hslot, S, Bc, quant=spec.quant,
+                int8=use_int8, oh_shift=oh_shift,
+            )  # (S, 3, G, Bc)
+            if ax is not None:
+                slot_hists = lax.psum(slot_hists, ax)
+            if spec.quant:
+                slot_hists = slot_hists * scale3[:, None, None]
 
         # ---- per-slot child hists: smaller from the pass, larger by
         # subtraction; scatter both into the pool. Work stays O(S), not
@@ -351,7 +437,6 @@ def grow_tree_rounds(
         ls_s = left_smaller[sl_c][:, None, None, None]
         left_s = jnp.where(ls_s, slot_hists, large_s)
         right_s = jnp.where(ls_s, large_s, slot_hists)
-        new_id_s = jnp.where(take, i + 1 + iota_S, L)
         hist = s.hist.at[sel_leaf].set(left_s, mode="drop")
         hist = hist.at[new_id_s].set(right_s, mode="drop")
 
@@ -399,6 +484,7 @@ def grow_tree_rounds(
 
         return _NState(
             i=i + n_split,
+            r=s.r,
             pleaf=pleaf_new,
             hist=hist,
             leaf_g=jnp.where(sel, rec.left_g, s.leaf_g)
@@ -422,6 +508,7 @@ def grow_tree_rounds(
 
     state = _NState(
         i=jnp.int32(0),
+        r=jnp.zeros(len(widths) + 1, jnp.int32),
         pleaf=jnp.where(valid_f > 0, 0, L).astype(jnp.int32),
         hist=hist,
         leaf_g=jnp.zeros(L, jnp.float32).at[0].set(root[0]),
@@ -438,4 +525,6 @@ def grow_tree_rounds(
     row_leaf = final.pleaf
     if valid is not None:
         row_leaf = jnp.where(valid > 0, row_leaf, -1)
+    if with_stats:
+        return final.tree, row_leaf, {"widths": widths, "rounds": final.r}
     return final.tree, row_leaf

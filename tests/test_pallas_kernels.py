@@ -23,7 +23,17 @@ from lightgbm_tpu.learner.histogram import (
 
 @pytest.fixture
 def interp(monkeypatch):
+    """Force the interpreted-pallas dispatch AND clear jit caches at
+    both ends: the growers' jit cache keys on (spec, shapes), not the
+    env, so a cached fallback trace from a neighboring test would be
+    silently reused under interp=1 (and vice versa), making the
+    interpret-vs-fallback comparisons vacuous."""
+    import jax
+
+    jax.clear_caches()
     monkeypatch.setenv("LGBM_TPU_PALLAS_INTERPRET", "1")
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="module")
@@ -81,10 +91,13 @@ def test_hist_nat_tpu_interpret_matches_fallback(interp, data):
                                atol=2e-3, rtol=1e-4)
 
 
-def test_hist_nat_int8_interpret_exact(interp, data):
+@pytest.mark.parametrize("oh_shift", [0, 4, 7])
+def test_hist_nat_int8_interpret_exact(interp, data, oh_shift):
     """Quantized int8 mode: s8 x s8 -> s32 sums are EXACT integers and
     must equal the f32 fallback bit-for-bit (integer levels within
-    +/-127 sum exactly in both paths at this size)."""
+    +/-127 sum exactly in both paths at this size). Every SWAR one-hot
+    scale (byte values 128/8/1, histogram.int8_oh_shift policy) must
+    rescale back to identical sums."""
     N, F, B, bins, _ = data
     from lightgbm_tpu.learner.histogram import (
         build_gh8_quant,
@@ -97,9 +110,137 @@ def test_hist_nat_int8_interpret_exact(interp, data):
     gh8q = build_gh8_quant(gq, hq, jnp.ones(N, jnp.float32))
     S = 6
     slot = jnp.asarray(rs.randint(0, S + 1, N).astype(np.int32))
-    out = hist_nat_slots(bins, gh8q, slot, S, B, quant=True, int8=True)
+    out = hist_nat_slots(bins, gh8q, slot, S, B, quant=True, int8=True,
+                         oh_shift=oh_shift)
     ref = _hist_nat_fallback(bins, gh8q, slot, S, B, quant=True)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_int8_oh_shift_policy():
+    from lightgbm_tpu.learner.histogram import int8_oh_shift
+
+    assert int8_oh_shift(10 ** 6, 4) == 0  # bench shape: full speed
+    assert int8_oh_shift(10 ** 6, 127) == 4  # 1M x 127 x 8 < 2^31
+    assert int8_oh_shift(18 * 10 ** 6, 127) is None  # ADVICE r4 wrap
+    assert int8_oh_shift(16 * 10 ** 6, 127) == 7
+
+
+def _grow_case(spec_kw, quant=False):
+    """Grow one tree on a synthetic set; returns (leaf_values, row_leaf,
+    node_feature, node_bin)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import BinnedDataset
+    from lightgbm_tpu.learner import GrowerSpec, grow_tree, make_split_params
+
+    rs = np.random.RandomState(11)
+    X = rs.randn(HIST_BLK, 6).astype(np.float32)
+    cfg = Config({"max_bin": 63, "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_numpy(X, cfg)
+    d = ds.device_arrays()
+    N = ds.num_rows_padded()
+    F = ds.num_used_features
+    if quant:
+        grad = jnp.asarray(
+            rs.randint(-2, 3, N).astype(np.float32)) * d["valid"]
+        hess = jnp.asarray(
+            rs.randint(1, 4, N).astype(np.float32)) * d["valid"]
+        gh_scale = jnp.asarray(np.float32([0.125, 0.25]))
+    else:
+        grad = jnp.asarray(rs.randn(N).astype(np.float32)) * d["valid"]
+        hess = jnp.ones(N, jnp.float32) * 0.25 * d["valid"]
+        gh_scale = None
+    spec_kw = dict(spec_kw)  # callers reuse their dict across runs
+    n_leaves = spec_kw.pop("num_leaves", 15)
+    params = make_split_params(Config({"num_leaves": n_leaves, "max_bin": 63,
+                                       "min_data_in_leaf": 5}))
+    spec = GrowerSpec(num_leaves=n_leaves, num_bins=ds.max_num_bin,
+                      max_depth=-1, **spec_kw)
+    tree, rl = grow_tree(
+        d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+        grad, hess, d["valid"], jnp.ones(F, bool), params, spec,
+        valid=d["valid"], gh_scale=gh_scale,
+    )
+    return (np.asarray(tree.leaf_value), np.asarray(rl),
+            np.asarray(tree.node_feature), np.asarray(tree.node_bin))
+
+
+def test_fused_round_ladder_matches_fallback(interp):
+    """Multi-width S-ladder (widths 8/32/48 at rounds_slots=48): the
+    lax.switch dispatch across kernel widths must reproduce the XLA
+    path's tree exactly."""
+    import os
+
+    import jax
+
+    kw = dict(rounds_slots=48, has_cat=False, num_leaves=63)
+    fused = _grow_case(kw)
+    os.environ["LGBM_TPU_PALLAS_INTERPRET"] = "0"
+    jax.clear_caches()
+    fb = _grow_case(kw)
+    np.testing.assert_allclose(fused[0], fb[0], atol=5e-4)
+    np.testing.assert_array_equal(fused[2], fb[2])
+    np.testing.assert_array_equal(fused[3], fb[3])
+
+
+def test_fused_round_efb_matches_fallback(interp):
+    """The fused kernel's in-kernel EFB decode (params cols 7-9) must
+    match decode_feature_bins on a genuinely bundled dataset."""
+    import os
+
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    rs = np.random.RandomState(21)
+    n = HIST_BLK
+    blocks = []
+    for b in range(3):
+        z = np.zeros((n, 6))
+        idx = rs.randint(0, 6, n)
+        z[np.arange(n), idx] = rs.rand(n) + 0.5
+        on = rs.rand(n) < 0.3
+        z[~on] = 0.0
+        blocks.append(z)
+    X = np.hstack([rs.randn(n, 2)] + blocks)
+    w = rs.randn(X.shape[1])
+    y = (X @ w + 0.3 * rs.randn(n) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "tpu_growth_mode": "rounds", "tpu_round_slots": 8}
+
+    def run():
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst = lgb.train(dict(params), ds, num_boost_round=3)
+        assert ds._binned.bundle_layout is not None  # bundling engaged
+        return bst.predict(X)
+
+    p_fused = run()
+    os.environ["LGBM_TPU_PALLAS_INTERPRET"] = "0"
+    jax.clear_caches()
+    p_fb = run()
+    np.testing.assert_allclose(p_fused, p_fb, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("quant,int8", [(False, False), (True, False),
+                                        (True, True)])
+def test_fused_round_grower_matches_fallback(interp, quant, int8):
+    """The fused partition+histogram kernel (has_cat=False dispatches
+    rounds.py onto pallas_hist._round_kernel) must reproduce the XLA
+    path's tree EXACTLY: same splits, same partition, same leaves."""
+    import os
+
+    import jax
+
+    kw = dict(rounds_slots=8, has_cat=False, quant=quant,
+              quant_int8=int8, quant_levels=4 if quant else 0)
+    fused = _grow_case(kw, quant=quant)
+    os.environ["LGBM_TPU_PALLAS_INTERPRET"] = "0"
+    jax.clear_caches()  # the grower jit baked the interpreted dispatch
+    fb = _grow_case(kw, quant=quant)
+    np.testing.assert_allclose(fused[0], fb[0], atol=5e-4)
+    assert (fused[1] == fb[1]).mean() > 0.999
+    np.testing.assert_array_equal(fused[2], fb[2])
+    np.testing.assert_array_equal(fused[3], fb[3])
 
 
 def test_take_and_segsum_interpret(interp, data):
