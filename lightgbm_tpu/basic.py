@@ -10,6 +10,7 @@ so that `reference=` mapper sharing and `free_raw_data` semantics hold.
 from __future__ import annotations
 
 import copy
+import os
 from pathlib import Path
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
@@ -247,6 +248,68 @@ class Dataset:
 
             path = str(self.data)
             fp = {_ra(k): v for k, v in self.params.items()}
+            cfg_file = Config(self.params)
+            # two_round streaming (dataset_loader.cpp:210): explicit
+            # config, or automatic above 1 GB of text — host memory
+            # stays O(chunk) + the binned matrix instead of O(file).
+            # Ineligible cases fall through to the whole-file loader:
+            # linear_tree (needs raw values), reference= datasets (must
+            # bin with the TRAINING set's mappers), constructor-level
+            # categorical_feature (column names unknown pre-parse).
+            stream_ok = (
+                not is_binary_file(path)
+                and not cfg_file.linear_tree
+                and self.reference is None
+                and self.categorical_feature in ("auto", None, "")
+            )
+            want_stream = cfg_file.two_round or (
+                stream_ok and os.path.getsize(path) > (1 << 30)
+            )
+            if want_stream and not stream_ok:
+                log.warning(
+                    "two_round streaming skipped: linear_tree / "
+                    "reference= / constructor categorical_feature need "
+                    "the whole-file loader"
+                )
+            if want_stream and stream_ok:
+                from .parsers import load_text_file_two_round
+
+                with _gt.scope("dataset construct (two_round stream)"):
+                    if not cfg_file.two_round:
+                        log.info(
+                            "large text file: streaming two_round load"
+                        )
+                    res = load_text_file_two_round(
+                        path, cfg_file,
+                        header=str(fp.get("header", "false")).lower()
+                        in ("true", "1"),
+                        label_column=fp.get("label_column", 0),
+                        weight_column=fp.get("weight_column", ""),
+                        group_column=fp.get("group_column", ""),
+                        ignore_column=fp.get("ignore_column", ""),
+                        categorical_feature=fp.get(
+                            "categorical_feature", ""),
+                    )
+                if res is not None:  # None = LibSVM fallback
+                    self._binned = res["binned"]
+                    md = self._binned.metadata
+                    if self.label is not None:
+                        md.label = np.asarray(self.label, np.float32)
+                    if self.weight is not None:
+                        md.weight = np.asarray(self.weight, np.float32)
+                    if self.group is not None:
+                        md.group = np.asarray(self.group, np.int64)
+                    if self.init_score is not None:
+                        md.init_score = np.asarray(
+                            self.init_score, np.float64)
+                    if self.position is not None:
+                        md.position = np.asarray(self.position, np.int32)
+                    if (self.feature_name == "auto"
+                            and res["feature_names"]):
+                        self.feature_name = res["feature_names"]
+                    if self.free_raw_data:
+                        self.data = None
+                    return self
             with _gt.scope("dataset construct (file)"):
                 if is_binary_file(path):
                     self._binned = load_binary(path)

@@ -42,6 +42,29 @@ def _choose_bin_dtype(max_num_bin: int) -> Any:
     return np.int32
 
 
+def bin_chunk(proto: "BinnedDataset", chunk: np.ndarray, dtype) -> np.ndarray:
+    """Bin one (rows, features) float chunk with a constructed dataset's
+    mappers (+ EFB encode) -> (G, rows) device-column matrix. Shared by
+    the Sequence streaming path and the two_round text loader — the
+    chunked second pass of the reference's two-pass extract
+    (dataset_loader.cpp:1399)."""
+    used = proto.used_features
+    sub = np.empty((len(used), chunk.shape[0]), dtype=dtype)
+    for i, f in enumerate(used):
+        sub[i] = proto.mappers[f].values_to_bins(chunk[:, f]).astype(dtype)
+    if proto.bundle_layout is not None:
+        from .bundling import encode
+
+        um = [proto.mappers[f] for f in used]
+        sub, _ = encode(
+            sub, proto.bundle_layout,
+            [m.num_bin for m in um],
+            [m.most_freq_bin for m in um],
+            dtype,
+        )
+    return sub
+
+
 @dataclass
 class Metadata:
     """Labels/weights/query groups/init scores (reference dataset.h:48)."""
@@ -496,7 +519,6 @@ class BinnedDataset:
         G = proto.bins.shape[0]
         dtype = proto.bins.dtype
         bins = np.empty((G, total), dtype=dtype)
-        used = proto.used_features
         row0 = 0
         for s in seqs:
             bs = int(getattr(s, "batch_size", 4096) or 4096)
@@ -504,22 +526,9 @@ class BinnedDataset:
                 chunk = np.asarray(s[lo : lo + bs], np.float64)
                 if chunk.ndim == 1:
                     chunk = chunk.reshape(1, -1)
-                sub = np.empty((len(used), chunk.shape[0]), dtype=dtype)
-                for i, f in enumerate(used):
-                    sub[i] = proto.mappers[f].values_to_bins(
-                        chunk[:, f]
-                    ).astype(dtype)
-                if proto.bundle_layout is not None:
-                    from .bundling import encode
-
-                    um = [proto.mappers[f] for f in used]
-                    sub, _ = encode(
-                        sub, proto.bundle_layout,
-                        [m.num_bin for m in um],
-                        [m.most_freq_bin for m in um],
-                        dtype,
-                    )
-                bins[:, row0 : row0 + chunk.shape[0]] = sub
+                bins[:, row0 : row0 + chunk.shape[0]] = bin_chunk(
+                    proto, chunk, dtype
+                )
                 row0 += chunk.shape[0]
         meta = Metadata(
             label=None if label is None else np.asarray(label, np.float32).ravel(),
@@ -532,7 +541,7 @@ class BinnedDataset:
         return BinnedDataset(
             bins=bins,
             mappers=proto.mappers,
-            used_features=used,
+            used_features=proto.used_features,
             num_data=total,
             metadata=meta,
             feature_names=list(proto.feature_names),

@@ -52,6 +52,11 @@ class GrowerSpec(NamedTuple):
     num_bins: int  # uniform bin-axis size B
     max_depth: int  # <= 0 means unlimited
     axis_name: Optional[str] = None
+    # static size of the data mesh axis (set by DataParallelGrower).
+    # > 1 enables the reduce-scatter histogram wire on eligible paths
+    # (rounds.py: integer dtype + per-rank feature ownership — the
+    # reference's bin.h:63-81 + data_parallel_tree_learner.cpp:286).
+    axis_size: int = 0
     # sorted-subset categorical splits (feature_histogram.hpp:449): set
     # when the dataset has categorical features wider than
     # max_cat_to_onehot; False keeps every categorical one-vs-rest and
@@ -136,8 +141,10 @@ class GrowerSpec(NamedTuple):
     # best split re-searched under the new bounds — less conservative
     # than basic, still violation-free by induction. The reference's
     # `advanced` per-threshold refinement (:858) is approximated by the
-    # same leaf-level bounds (documented deviation). Sequential permuted
-    # growth only.
+    # same leaf-level bounds (documented deviation). Supported by both
+    # the sequential permuted grower (per-split recompute) and the
+    # rounds grower (per-round recompute + same-round conflict guard,
+    # rounds.py).
     mono_mode: int = 0
     # dataset has at least one categorical feature: rounds-mode partition
     # updates need the per-row category-set test only then; all-numerical
@@ -251,6 +258,58 @@ def monotone_child_intervals(rec: SplitRecord, mono, lo, ro, cur_min, cur_max):
     return lmin, lmax, rmin, rmax
 
 
+def make_node_candidates(spec: GrowerSpec, params: SplitParams, feat_mask,
+                         num_bins, nan_bin, rng_key, group_mat, cegb,
+                         F: int):
+    """Per-node split-candidate machinery shared by the permuted and
+    rounds growers: interaction-group filtering (ColSampler,
+    col_sampler.hpp), feature_fraction_bynode sampling, extra_trees
+    random thresholds, and the CEGB DeltaGain penalty
+    (cost_effective_gradient_boosting.hpp:79 — with the per-tree-path
+    lazy approximation, see DESIGN_DECISIONS.md). Returns
+    node_candidates(salt, child_groups, path_used_child, child_count,
+    feat_used) -> (feat_mask, rand_bin, penalty), keyed on the node
+    index so draws are deterministic per tree position."""
+
+    def node_candidates(salt, child_groups, path_used_child, child_count,
+                        feat_used):
+        fm = feat_mask
+        rb = None
+        pen = None
+        if spec.n_groups:
+            fm = fm & jnp.any(group_mat & child_groups[:, None], axis=0)
+        if spec.ff_bynode:
+            # sample ceil(frac * currently-valid) from the VALID set
+            # (ColSampler samples from used_feature_indices_, so a node
+            # always keeps >= 1 candidate)
+            k1 = jax.random.fold_in(rng_key, 2 * salt)
+            u = jnp.where(fm, jax.random.uniform(k1, (F,)), jnp.inf)
+            n_valid = jnp.sum(fm)
+            n_pick = jnp.maximum(
+                jnp.ceil(
+                    params.feature_fraction_bynode * n_valid
+                ).astype(jnp.int32),
+                1,
+            )
+            rank = jnp.argsort(jnp.argsort(u))
+            fm = fm & (rank < n_pick)
+        if spec.extra_trees:
+            k2 = jax.random.fold_in(rng_key, 2 * salt + 1)
+            u = jax.random.uniform(k2, (F,))
+            n_thr = jnp.maximum(num_bins - 1 - (nan_bin >= 0), 1)
+            rb = jnp.floor(u * n_thr).astype(jnp.int32)
+        if spec.cegb:
+            pen = params.cegb_tradeoff * (
+                params.cegb_penalty_split * child_count
+                + cegb.coupled * (~feat_used).astype(jnp.float32)
+                + cegb.lazy * child_count
+                * (~path_used_child).astype(jnp.float32)
+            )
+        return fm, rb, pen
+
+    return node_candidates
+
+
 def _empty_best(L: int, B: int) -> SplitRecord:
     zi = jnp.zeros(L, jnp.int32)
     zf = jnp.zeros(L, jnp.float32)
@@ -318,6 +377,7 @@ def grow_tree(
         return grow_tree_rounds(
             bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
             feat_mask, params, spec, valid, bundle, gh_scale,
+            rng_key=rng_key, group_mat=group_mat, cegb=cegb,
         )
     if spec.partition == "permuted":
         from .permuted import grow_tree_permuted

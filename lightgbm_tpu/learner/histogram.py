@@ -342,30 +342,41 @@ def hist_round(
     return o3, pl_new
 
 
+# the take/seg_sum kernels materialize an (L, HIST_BLK) f32 one-hot
+# tile in VMEM per grid step; num_leaves may legally reach 131072
+# (config.h num_leaves check), at which point the tile alone (131072 x
+# 2048 x 4 = 1 GB) dwarfs the ~16 MB scoped budget and Mosaic compile
+# fails where plain XLA take/scatter worked (ADVICE r4 medium). Cap the
+# one-hot tile + in/out blocks at a conservative 8 MB -> L <= ~960.
+_TAKE_L_CAP = (8 * 2 ** 20) // (HIST_BLK * 4)
+
+
 def take_cols(tab: jax.Array, idx: jax.Array) -> jax.Array:
     """(k, L) table, (N,) int32 indices -> (k, N) tab[:, idx].
 
     TPU: one-hot MXU contraction (pallas take_small_tpu, ~0.1 ms at 1M
-    rows); elsewhere (or unaligned N): plain take. Negative / >= L
-    indices return 0 on the kernel path and must be pre-clipped by
-    callers that rely on take's wrapping (none do)."""
+    rows) while L fits the VMEM one-hot tile (_TAKE_L_CAP); elsewhere
+    (large L, unaligned N, no TPU): plain take. Negative / >= L indices
+    return 0 on both paths."""
     N = idx.shape[0]
-    if _use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK:
+    L = tab.shape[1]
+    if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
+            and L <= _TAKE_L_CAP):
         from .pallas_hist import take_small_tpu
 
         return take_small_tpu(tab, idx, interpret=_interpret_pallas())
-    L = tab.shape[1]
     out = jnp.take(tab, jnp.clip(idx, 0, L - 1), axis=1)
     return jnp.where(((idx >= 0) & (idx < L))[None, :], out, 0.0)
 
 
 def seg_sum(vals: jax.Array, idx: jax.Array, num_out: int) -> jax.Array:
     """(k, N) values + (N,) int32 indices -> (k, num_out) per-index
-    column sums. TPU: one-hot MXU contraction (pallas seg_sum_tpu);
-    elsewhere: XLA scatter-add. Out-of-range indices are dropped on
-    both paths."""
+    column sums. TPU: one-hot MXU contraction (pallas seg_sum_tpu)
+    while num_out fits the VMEM one-hot tile (_TAKE_L_CAP); elsewhere:
+    XLA scatter-add. Out-of-range indices are dropped on both paths."""
     k, N = vals.shape
-    if _use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK:
+    if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
+            and num_out <= _TAKE_L_CAP):
         from .pallas_hist import seg_sum_tpu
 
         return seg_sum_tpu(vals, idx, num_out,

@@ -67,6 +67,7 @@ from .grower import (
     _empty_best,
     _get_best,
     _set_best,
+    make_node_candidates,
     monotone_child_intervals,
     split_leaf_outputs,
 )
@@ -205,52 +206,13 @@ def grow_tree_permuted(
             "voting / forced splits / rounds"
         )
 
-    def node_candidates(salt, child_groups, path_used_child, child_count,
-                        feat_used):
-        """(feat_mask, rand_bin, penalty) for ONE node's split search."""
-        fm = feat_mask
-        rb = None
-        pen = None
-        if spec.n_groups:
-            # features in any still-legal constraint group (ColSampler
-            # interaction filtering)
-            fm = fm & jnp.any(group_mat & child_groups[:, None], axis=0)
-        if spec.ff_bynode:
-            # sample ceil(frac * currently-valid) from the VALID set
-            # (ColSampler samples from used_feature_indices_, so a node
-            # always keeps >= 1 candidate)
-            k1 = jax.random.fold_in(rng_key, 2 * salt)
-            u = jnp.where(fm, jax.random.uniform(k1, (F,)), jnp.inf)
-            n_valid = jnp.sum(fm)
-            n_pick = jnp.maximum(
-                jnp.ceil(
-                    params.feature_fraction_bynode * n_valid
-                ).astype(jnp.int32),
-                1,
-            )
-            rank = jnp.argsort(jnp.argsort(u))
-            fm = fm & (rank < n_pick)
-        if spec.extra_trees:
-            k2 = jax.random.fold_in(rng_key, 2 * salt + 1)
-            u = jax.random.uniform(k2, (F,))
-            n_thr = jnp.maximum(num_bins - 1 - (nan_bin >= 0), 1)
-            rb = jnp.floor(u * n_thr).astype(jnp.int32)
-        if spec.cegb:
-            # DeltaGain (cost_effective_gradient_boosting.hpp:79). The
-            # lazy per-data cost is approximated PER-TREE-PATH: rows are
-            # considered charged for a feature once an ancestor split of
-            # the CURRENT tree used it, whereas the reference keeps a
-            # model-wide per-(row, feature) bitset across trees —
-            # later trees here re-charge rows earlier trees already
-            # acquired (documented deviation; exact tracking would add
-            # an (N, F) cross-iteration carry).
-            pen = params.cegb_tradeoff * (
-                params.cegb_penalty_split * child_count
-                + cegb.coupled * (~feat_used).astype(jnp.float32)
-                + cegb.lazy * child_count
-                * (~path_used_child).astype(jnp.float32)
-            )
-        return fm, rb, pen
+    # shared per-node machinery (grower.make_node_candidates): the
+    # DeltaGain per-tree-path lazy approximation and its rationale are
+    # documented there and in DESIGN_DECISIONS.md
+    node_candidates = make_node_candidates(
+        spec, params, feat_mask, num_bins, nan_bin, rng_key, group_mat,
+        cegb, F,
+    )
 
     def exp_hist(h, g_sum, h_sum, c_sum):
         """Bundle-space histogram -> per-feature for the split scan."""

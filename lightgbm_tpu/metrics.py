@@ -322,6 +322,52 @@ class CrossEntropyMetric(_PointwiseMetric):
         return -(y * np.log(p) + (1 - y) * np.log(1 - p))
 
 
+class CrossEntropyLambdaMetric(Metric):
+    """reference xentropy_metric.hpp:165 CrossEntropyLambdaMetric
+    (alias xentlambda): weights enter the loss itself (intensity
+    weighting via hhat), and the average is over num_data, NOT the
+    weight sum."""
+
+    name = "cross_entropy_lambda"
+
+    def eval(self, score):
+        eps = 1e-12
+        hhat = np.log1p(np.exp(score))  # xentlambda ConvertOutput
+        w = self.weight if self.weight is not None else 1.0
+        p = np.clip(1.0 - np.exp(-w * hhat), eps, 1.0 - eps)
+        y = self.label
+        loss = -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+        return [(self.name, float(np.mean(loss)), False)]
+
+
+class KullbackLeiblerMetric(_PointwiseMetric):
+    """reference xentropy_metric.hpp:249 KullbackLeiblerDivergence:
+    cross-entropy plus the (weight-averaged, score-independent) label
+    entropy offset — KL(y || p) = CE(y, p) - H(y)."""
+
+    name = "kullback_leibler"
+
+    def transform(self, score):
+        return _sigmoid(score)
+
+    def point(self, y, p):
+        eps = 1e-15
+        p = np.clip(p, eps, 1 - eps)
+        return -(y * np.log(p) + (1 - y) * np.log(1 - p))
+
+    def eval(self, score):
+        y = self.label.astype(np.float64)
+        yent = np.zeros_like(y)
+        m = y > 0
+        yent[m] += y[m] * np.log(y[m])
+        q = 1.0 - y
+        mq = q > 0
+        yent[mq] += q[mq] * np.log(q[mq])
+        offset = self._avg(yent)
+        ce = self._avg(self.point(y, self.transform(score)))
+        return [(self.name, float(offset + ce), False)]
+
+
 class NDCGMetric(Metric):
     name = "ndcg"
     higher_better = True
@@ -406,6 +452,10 @@ _METRICS: Dict[str, type] = {
     "multi_error": MultiErrorMetric,
     "auc_mu": AucMuMetric,
     "cross_entropy": CrossEntropyMetric, "xentropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "xentlambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KullbackLeiblerMetric,
+    "kldiv": KullbackLeiblerMetric,
     "ndcg": NDCGMetric, "lambdarank": NDCGMetric, "rank_xendcg": NDCGMetric,
     "map": MapMetric, "mean_average_precision": MapMetric,
 }
@@ -416,7 +466,9 @@ _DEFAULT_METRIC = {
     "poisson": "poisson", "quantile": "quantile", "mape": "mape",
     "gamma": "gamma", "tweedie": "tweedie", "binary": "binary_logloss",
     "multiclass": "multi_logloss", "multiclassova": "multi_logloss",
-    "cross_entropy": "cross_entropy", "lambdarank": "ndcg",
+    "cross_entropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "lambdarank": "ndcg",
     "rank_xendcg": "ndcg",
 }
 

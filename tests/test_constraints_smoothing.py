@@ -187,6 +187,55 @@ def test_monotone_methods_violation_scan(method, direction):
     _check_monotone(bst, X, 0, direction)
 
 
+@pytest.mark.parametrize("method", ["basic", "intermediate"])
+@pytest.mark.parametrize("direction", [1, -1])
+def test_monotone_rounds_mode_violation_scan(method, direction):
+    """Monotone constraints on the TPU fast path (VERDICT r4 item 3):
+    the round-batched grower enforces basic via inherited intervals and
+    intermediate via the per-round ancestry-bounds recompute with the
+    same-round opposite-subtree conflict guard — deep trees grown in
+    rounds mode must hold the constraint globally."""
+    rs = np.random.RandomState(5)
+    n = 4000
+    X = rs.randn(n, 4)
+    y = direction * (1.5 * X[:, 0] + 0.8 * np.sin(4 * X[:, 0])) \
+        + X[:, 1] + 0.2 * rs.randn(n)
+    mono = [direction, 0, 0, 0]
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 63, "verbosity": -1,
+         "monotone_constraints": mono, "learning_rate": 0.2,
+         "min_data_in_leaf": 3, "monotone_constraints_method": method,
+         "tpu_growth_mode": "rounds"},
+        ds, num_boost_round=10,
+    )
+    _check_monotone(bst, X, 0, direction)
+
+
+def test_monotone_rounds_quality_close_to_exact():
+    """Rounds-mode constrained training must stay within tolerance of
+    the sequential exact grower's quality (same config, both methods)."""
+    rs = np.random.RandomState(9)
+    n = 4000
+    X = rs.randn(n, 4)
+    y = 1.2 * X[:, 0] + 0.6 * np.sin(3 * X[:, 0]) + 0.8 * X[:, 1] \
+        + 0.2 * rs.randn(n)
+    for method in ("basic", "intermediate"):
+        mse = {}
+        for mode in ("exact", "rounds"):
+            ds = lgb.Dataset(X, label=y, free_raw_data=False)
+            bst = lgb.train(
+                {"objective": "regression", "num_leaves": 31,
+                 "verbosity": -1, "monotone_constraints": [1, 0, 0, 0],
+                 "learning_rate": 0.15, "min_data_in_leaf": 5,
+                 "monotone_constraints_method": method,
+                 "tpu_growth_mode": mode},
+                ds, num_boost_round=15,
+            )
+            mse[mode] = float(np.mean((bst.predict(X) - y) ** 2))
+        assert mse["rounds"] <= mse["exact"] * 1.15, (method, mse)
+
+
 def test_monotone_intermediate_quality_at_least_basic():
     """The intermediate method bounds children by the opposite
     subtree's ACTUAL extrema instead of the frozen split midpoint —
