@@ -239,7 +239,13 @@ def main() -> None:
     # to the timed loop. jax may already be imported (sitecustomize, or
     # the CPU-fallback import above) and reads the env at import time,
     # so set it at the config level as well.
-    cache_dir = os.path.join(REPO, ".jax_cache")
+    # fingerprint the cache by host CPU flags: XLA:CPU AOT entries embed
+    # machine features the cache key omits — a cache written on another
+    # host (the driver moves between machines) can SIGILL on this one
+    sys.path.insert(0, REPO)
+    from lightgbm_tpu._cache import machine_tag
+
+    cache_dir = os.path.join(REPO, f".jax_cache_{machine_tag()}")
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     try:

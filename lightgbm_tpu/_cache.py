@@ -14,6 +14,27 @@ import os
 _done = False
 
 
+def machine_tag() -> str:
+    """Host fingerprint for persistent-cache directories. XLA:CPU AOT
+    entries embed machine features that the cache KEY omits, so an
+    entry written on a different host (the bench/test driver moves
+    between machines) loads here and dies with SIGILL/SIGSEGV after
+    warning "Target machine feature ... is not supported on the host
+    machine" — fingerprinted directories make that impossible."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha1(line.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine() or "generic"
+
+
 def ensure_compile_cache() -> None:
     """Idempotent; call before the first jit dispatch. No-op when the
     user configured a cache themselves, opted out, or jax isn't on an

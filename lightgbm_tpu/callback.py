@@ -164,9 +164,19 @@ def early_stopping(
             eval_name_splitted = env.evaluation_result_list[i][1].split(" ")
             if first_metric_only and first_metric[0] != eval_name_splitted[-1]:
                 continue
-            if env.evaluation_result_list[i][0] == "cv_agg" or (
+            # reference callback.py:521: train-set metrics never trigger
+            # the stop — for cv that means cv_agg entries whose metric
+            # name carries the TRAIN set's prefix (validation-fold
+            # cv_agg entries DO stop; skipping all cv_agg entries would
+            # disable cv early stopping entirely)
+            if (
+                env.evaluation_result_list[i][0] == "cv_agg"
+                and eval_name_splitted[0] in ("train", "training")
+            ) or (
                 env.model is not None
-                and env.evaluation_result_list[i][0] == env.model._train_data_name
+                and hasattr(env.model, "_train_data_name")
+                and env.evaluation_result_list[i][0]
+                == env.model._train_data_name
             ):
                 _final_iteration_check(env, eval_name_splitted, i)
                 continue

@@ -320,6 +320,7 @@ def hist_round(
     int8: bool = False,
     oh_shift: int = 0,
     efb: bool = False,
+    cat_mask=None,
 ):
     """Fused round step -> ((S, 3, F, B) f32 histograms, (N,) new
     row->leaf). Callers must check can_hist_round first; histogram
@@ -331,7 +332,7 @@ def hist_round(
     out, pl_new = hist_round_tpu(
         bins_fm, gh8, pleaf, params, col_onehot, num_slots, num_bins,
         nat_ch, int8=bool(int8 and quant), oh_shift=oh_shift, efb=efb,
-        interpret=_interpret_pallas(),
+        cat_mask=cat_mask, interpret=_interpret_pallas(),
     )
     if int8 and quant:
         out = out.astype(jnp.float32) * (1.0 / _swar_divisor(oh_shift))

@@ -208,10 +208,10 @@ def _swar_onehot(bins_row, B: int, blk: int, oh_shift: int):
 
 
 def _round_kernel(
-    params_ref, coh_ref, bins_ref, gh_ref, pleaf_ref,  # inputs
+    params_ref, coh_ref, cat_ref, bins_ref, gh_ref, pleaf_ref,  # inputs
     out_ref, pl_out_ref,  # outputs
     *, F: int, B: int, blk: int, S: int, nat_ch: int, int8: bool,
-    oh_shift: int, efb: bool,
+    oh_shift: int, efb: bool, has_cat: bool,
 ):
     """Fused round step: partition decision + slot-packed histograms
     in ONE data pass (VERDICT r4 item 2).
@@ -269,6 +269,22 @@ def _round_kernel(
         dec = jnp.where(in_r, t + (t >= mfb).astype(jnp.float32), mfb)
         fb = jnp.where(mfb >= 0.0, dec, fb)
     gl = (fb <= thr) | (dl & (fb == nanb))  # (S, blk)
+    if has_cat:
+        # categorical slots: go left iff the row's bin is in the
+        # slot's category set. The row's OWN split-column bin (merge
+        # over disjoint memberships) gets a single-feature one-hot and
+        # one (S, B) @ (B, blk) contraction against the per-slot masks
+        # — the (L*B,) flat gather this replaces costs ~10 ms at 1M
+        # rows (tools/tpu_gather_probe.py).
+        is_cat_s = params_ref[:, 10:11] != 0  # (S, 1)
+        fb_own = jnp.sum(jnp.where(memb, fb, 0.0), axis=0,
+                         keepdims=True)  # (1, blk) f32 integer-valued
+        ohfb = _swar_onehot(fb_own.astype(jnp.int32), B, blk, 7)  # 0/1 s8
+        hits = lax.dot_general(
+            cat_ref[...], ohfb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # (S, blk): mask[s, fb_own[r]]
+        gl = jnp.where(is_cat_s, hits > 0, gl)
 
     # new per-row leaf ids: memberships are disjoint, so summing the
     # masked deltas over the slot axis applies at most one update
@@ -312,6 +328,7 @@ def hist_round_tpu(
     int8: bool = False,
     oh_shift: int = 0,
     efb: bool = False,
+    cat_mask=None,  # (S, B) s8 per-slot category sets, or None
     blk: int = HIST_BLK,
     interpret: bool = False,
 ):
@@ -323,15 +340,20 @@ def hist_round_tpu(
     assert N % blk == 0, (N, blk)
     S = num_slots
     nb = N // blk
+    has_cat = cat_mask is not None
+    if cat_mask is None:
+        cat_mask = jnp.zeros((S, num_bins), jnp.int8)
     out, pl_new = pl.pallas_call(
         functools.partial(
             _round_kernel, F=F, B=num_bins, blk=blk, S=S, nat_ch=nat_ch,
-            int8=int8, oh_shift=oh_shift, efb=efb,
+            int8=int8, oh_shift=oh_shift, efb=efb, has_cat=has_cat,
         ),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((S, 16), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((S, F), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((S, num_bins), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((F, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((CH, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
@@ -347,7 +369,7 @@ def hist_round_tpu(
             jax.ShapeDtypeStruct((1, N), jnp.int32),
         ],
         interpret=interpret,
-    )(params, col_onehot, bins_fm, gh8, pleaf.reshape(1, N))
+    )(params, col_onehot, cat_mask, bins_fm, gh8, pleaf.reshape(1, N))
     return out, pl_new.reshape(N)
 
 

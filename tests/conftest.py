@@ -27,7 +27,14 @@ jax.config.update("jax_platforms", "cpu")
 
 # persistent compile cache: the grower's while_loop compiles are 10-40s
 # each on CPU; cache them across test runs
-jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_comp_cache")
+# cache dir fingerprinted by host CPU flags (cross-machine XLA:CPU AOT
+# entries SIGILL — see lightgbm_tpu._cache.machine_tag)
+from lightgbm_tpu._cache import machine_tag
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    f"/root/.cache/jax_comp_cache_{machine_tag()}",
+)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 

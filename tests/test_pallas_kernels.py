@@ -253,6 +253,40 @@ def test_fused_round_efb_matches_fallback(interp):
     np.testing.assert_allclose(p_fused, p_fb, atol=1e-5, rtol=1e-5)
 
 
+def test_fused_round_categorical_matches_fallback(interp):
+    """Categorical splits inside the fused kernel (per-slot category
+    masks contracted against the row's own-bin one-hot) must reproduce
+    the XLA path's trees through the train API."""
+    import os
+
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    rs = np.random.RandomState(31)
+    n = HIST_BLK
+    Xc = rs.randint(0, 12, (n, 2)).astype(np.float64)
+    Xn = rs.randn(n, 4)
+    X = np.column_stack([Xc, Xn])
+    y = ((Xc[:, 0] % 3 == 0).astype(float) * 2 + Xn[:, 0]
+         + 0.3 * rs.randn(n) > 1).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "categorical_feature": "0,1",
+              "tpu_growth_mode": "rounds", "tpu_round_slots": 8}
+
+    def run():
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst = lgb.train(dict(params), ds, num_boost_round=3)
+        return bst.predict(X)
+
+    p_fused = run()
+    os.environ["LGBM_TPU_PALLAS_INTERPRET"] = "0"
+    jax.clear_caches()
+    p_fb = run()
+    np.testing.assert_allclose(p_fused, p_fb, atol=1e-5, rtol=1e-5)
+
+
 @pytest.mark.parametrize("quant,int8", [(False, False), (True, False),
                                         (True, True)])
 def test_fused_round_grower_matches_fallback(interp, quant, int8):

@@ -10,10 +10,31 @@ training."""
 
 from __future__ import annotations
 
+import gc
+
 import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_heap():
+    """Free every cached executable before this module's 8-device mesh
+    compiles: late in the full suite the process heap holds hundreds of
+    live executables, and serializing THIS module's large shard_map'd
+    fused-step executable into the persistent compile cache has
+    segfaulted inside jax's put_executable_and_time under that memory
+    pressure (exit 139 at ~76% of the suite; standalone runs pass).
+    Clearing first costs a few recompiles and removes the crash."""
+    import jax
+
+    from lightgbm_tpu.boosting import _FUSED_STEP_CACHE
+
+    _FUSED_STEP_CACHE.clear()
+    jax.clear_caches()
+    gc.collect()
+    yield
 
 
 def _binary_problem(n=4096, f=10, seed=3):
