@@ -256,7 +256,6 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         sys.stderr.write(f"[bench] compile cache not enabled: {e}\n")
 
-    sys.path.insert(0, REPO)
     import lightgbm_tpu as lgb
 
     save_partial(stage="data", platform=platform, rows=rows, leaves=leaves,

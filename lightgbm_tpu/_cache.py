@@ -26,7 +26,9 @@ def machine_tag() -> str:
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
-                if line.startswith("flags"):
+                # x86 says "flags", ARM says "Features" — either is the
+                # ISA-extension list that decides AOT compatibility
+                if line.lower().startswith(("flags", "features")):
                     return hashlib.sha1(line.encode()).hexdigest()[:10]
     except OSError:
         pass

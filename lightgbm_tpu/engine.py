@@ -328,6 +328,39 @@ def cv(
         and all(b._gbdt.fused_eligible() for b in cvbooster.boosters)
     )
     results = collections.defaultdict(list)
+
+    def _cv_iteration(i: int, fold_evals) -> bool:
+        """Aggregate one iteration's per-fold eval tuples into results,
+        replay cb_after; returns True when early stopping fired (shared
+        by the fused replay and the sync fold loop so semantics cannot
+        drift)."""
+        merged: Dict[Tuple[str, str, bool], List[float]] = (
+            collections.OrderedDict()
+        )
+        for one in fold_evals:
+            for dn, mn, v, hb in one:
+                merged.setdefault((dn, mn, hb), []).append(v)
+        agg = [
+            ("cv_agg", f"{dn} {mn}", float(np.mean(vs)), hb,
+             float(np.std(vs)))
+            for (dn, mn, hb), vs in merged.items()
+        ]
+        for (dn, mn, hb), vs in merged.items():
+            results[f"{dn} {mn}-mean"].append(float(np.mean(vs)))
+            results[f"{dn} {mn}-stdv"].append(float(np.std(vs)))
+        try:
+            for cb in cb_after:
+                cb(CallbackEnv(cvbooster, params, i, 0, num_boost_round,
+                               agg))
+        except EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for bst in cvbooster.boosters:
+                bst.best_iteration = cvbooster.best_iteration
+            for k in results:
+                results[k] = results[k][: cvbooster.best_iteration]
+            return True
+        return False
+
     if use_fused_cv:
         for bst in cvbooster.boosters:
             bst._gbdt.fused_start(track_train=eval_train_metric)
@@ -344,40 +377,29 @@ def cv(
             n_done = min(len(r) for r in fold_records) if fold_records else 0
             for j in range(n_done):
                 i = done + j
-                merged: Dict[Tuple[str, str, bool], List[float]] = (
-                    collections.OrderedDict()
-                )
-                for recs in fold_records:
-                    for dn, mn, v, hb in recs[j]:
-                        merged.setdefault((dn, mn, hb), []).append(v)
-                agg = [
-                    ("cv_agg", f"{dn} {mn}", float(np.mean(vs)), hb,
-                     float(np.std(vs)))
-                    for (dn, mn, hb), vs in merged.items()
-                ]
-                for (dn, mn, hb), vs in merged.items():
-                    results[f"{dn} {mn}-mean"].append(float(np.mean(vs)))
-                    results[f"{dn} {mn}-stdv"].append(float(np.std(vs)))
-                try:
-                    for cb in cb_after:
-                        cb(CallbackEnv(cvbooster, params, i, 0,
-                                       num_boost_round, agg))
-                except EarlyStopException as e:
-                    cvbooster.best_iteration = e.best_iteration + 1
+                if _cv_iteration(i, [recs[j] for recs in fold_records]):
+                    # keep trees THROUGH the stop iteration (i+1),
+                    # matching the sync fold loop and engine.train; only
+                    # the chunk's blindly-trained tail drops
                     for bst in cvbooster.boosters:
-                        bst.best_iteration = cvbooster.best_iteration
-                        # keep trees THROUGH the stop iteration (i+1),
-                        # matching the sync fold loop and engine.train;
-                        # only the chunk's blindly-trained tail drops
                         bst._gbdt.fused_truncate(
                             bst._gbdt._init_iters + i + 1
                         )
-                    for k in results:
-                        results[k] = results[k][: cvbooster.best_iteration]
                     stop = True
                     break
+            n_recorded = done + n_done  # iterations with results rows
             done += max(n_done, 1)
-            if any(b._gbdt._stopped for b in cvbooster.boosters):
+            if not stop and any(
+                b._gbdt._stopped for b in cvbooster.boosters
+            ):
+                # a fold hit the no-splittable-leaf stop mid-chunk: its
+                # records (and results) end early — clamp EVERY fold's
+                # trees to the recorded length so num_trees() always
+                # agrees with the results lists
+                for bst in cvbooster.boosters:
+                    bst._gbdt.fused_truncate(
+                        bst._gbdt._init_iters + n_recorded
+                    )
                 break
         for bst in cvbooster.boosters:
             bst._gbdt._materialize()
@@ -388,32 +410,13 @@ def cv(
                                None))
             for bst in cvbooster.boosters:
                 bst.update(fobj=fobj)
-            # aggregate
-            merged = collections.OrderedDict()
+            fold_evals = []
             for bst in cvbooster.boosters:
                 one = bst.eval_valid(feval)
                 if eval_train_metric:
                     one = bst.eval_train(feval) + one
-                for dn, mn, v, hb in one:
-                    merged.setdefault((dn, mn, hb), []).append(v)
-            agg = [
-                ("cv_agg", f"{dn} {mn}", float(np.mean(vs)), hb,
-                 float(np.std(vs)))
-                for (dn, mn, hb), vs in merged.items()
-            ]
-            for (dn, mn, hb), vs in merged.items():
-                results[f"{dn} {mn}-mean"].append(float(np.mean(vs)))
-                results[f"{dn} {mn}-stdv"].append(float(np.std(vs)))
-            try:
-                for cb in cb_after:
-                    cb(CallbackEnv(cvbooster, params, i, 0, num_boost_round,
-                                   agg))
-            except EarlyStopException as e:
-                cvbooster.best_iteration = e.best_iteration + 1
-                for bst in cvbooster.boosters:
-                    bst.best_iteration = cvbooster.best_iteration
-                for k in results:
-                    results[k] = results[k][: cvbooster.best_iteration]
+                fold_evals.append(one)
+            if _cv_iteration(i, fold_evals):
                 break
     out = dict(results)
     if return_cvbooster:
