@@ -1,0 +1,41 @@
+"""Trace-safety static analysis suite (the USE_DEBUG build analog).
+
+The reference ships a `USE_DEBUG` build whose internal assertions
+(`CheckSplit`, serial_tree_learner.h:174) catch learner drift at the
+iteration it happens. Our failure modes are different — silent
+retraces, dtype widening on the int32 quantized wire, stale device
+constants baked into cached traced steps — and every one of them is
+detectable BEFORE runtime by inspecting source ASTs and jaxprs. Three
+cooperating passes (docs/STATIC_ANALYSIS.md):
+
+- `lint`        AST linter for JAX hazards inside traced code paths
+- `jaxpr_audit` abstract-traces the hot entry points and asserts
+                machine-checkable contracts (int32 wire, no host
+                callbacks, executable-size budgets)
+- `retrace`     runtime jit-cache-miss guard (context manager + pytest
+                fixture) with `jax.checking_leaks` wired in
+
+Run `python -m lightgbm_tpu.analysis --strict` (CI hook), or use the
+pieces directly:
+
+    from lightgbm_tpu.analysis import lint_package, run_audits
+    from lightgbm_tpu.analysis.retrace import retrace_guard
+"""
+
+from .lint import Finding, RULES, lint_package, lint_source, format_findings
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "lint_package",
+    "lint_source",
+    "format_findings",
+    "run_audits",
+]
+
+
+def run_audits(*args, **kwargs):
+    """Lazy forward to jaxpr_audit.run_audits (imports jax)."""
+    from .jaxpr_audit import run_audits as _run
+
+    return _run(*args, **kwargs)

@@ -1,0 +1,94 @@
+"""CLI: `python -m lightgbm_tpu.analysis [--strict] [...]`.
+
+Runs the trace-safety lint over the package source, then the jaxpr
+invariant audits, and prints a combined report. `--strict` (the CI /
+tier-1 hook mode) exits 1 on any unsuppressed lint violation or failed
+jaxpr contract; the default mode reports and exits 0.
+
+The audits need a multi-device CPU mesh; this entry point forces
+`jax_platforms=cpu` with 8 virtual devices (same as tests/conftest.py)
+so a bare invocation never touches real accelerators.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_mesh() -> None:
+    """cpu + 8 virtual devices BEFORE any backend initializes (package
+    import already loaded jax, but the backend is lazy — mirror the
+    conftest.py override)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.analysis",
+        description="trace-safety static analysis: AST lint + jaxpr "
+        "invariant audit (docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any violation / failed contract")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="skip the jaxpr audits (no jax backend needed)")
+    ap.add_argument("--audit-only", action="store_true",
+                    help="skip the AST lint")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed lint findings")
+    ap.add_argument("--update-budget", action="store_true",
+                    help="rewrite jaxpr_budget.json from current sizes "
+                    "(+25%% headroom); review the diff before commit")
+    ap.add_argument("--package", default=None,
+                    help="package directory to lint (default: the "
+                    "installed lightgbm_tpu package)")
+    args = ap.parse_args(argv)
+
+    failed = False
+
+    if not args.audit_only:
+        from .lint import format_findings, lint_package
+
+        pkg = args.package
+        if pkg is None:
+            import lightgbm_tpu
+
+            pkg = os.path.dirname(lightgbm_tpu.__file__)
+        findings = lint_package(pkg)
+        print(format_findings(findings,
+                              show_suppressed=args.show_suppressed))
+        if any(not f.suppressed for f in findings):
+            failed = True
+
+    if not args.lint_only:
+        _force_cpu_mesh()
+        from .jaxpr_audit import run_audits
+
+        results = run_audits(update_budget=args.update_budget)
+        for r in results:
+            print(r.format())
+        if not all(r.ok for r in results):
+            failed = True
+        if args.update_budget:
+            print("jaxpr_budget.json updated")
+
+    if failed:
+        print("analysis: FAIL" if args.strict else
+              "analysis: violations found (non-strict: exit 0)")
+        return 1 if args.strict else 0
+    print("analysis: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,495 @@
+"""Jaxpr invariant auditor: machine-checkable contracts on hot paths.
+
+Abstractly traces the fused round kernel, the data-parallel grower and
+the quantized reduce-scatter wire (no data, no compile — jaxpr
+construction only, a couple of seconds on CPU) and asserts contracts
+that every perf/correctness regression so far would have tripped:
+
+- the int32 quantized wire: `reduce_scatter` present, every wire
+  operand integer-typed (no f32/f64 widening of the histogram wire);
+- the overflow gate (ADVICE r5, histogram.rs_exact_ok): past the
+  2^31 global / 2^24 per-shard exactness bounds the wire must VANISH
+  and the f32 psum fallback take over;
+- no host callbacks (`pure_callback`/`io_callback`/...) inside device
+  loops — a silent ~100 ms sync per iteration on the axon runtime;
+- no float64 anywhere (dtype widening guard — the package is f32/
+  int32 end to end);
+- flattened jaxpr size stays under a checked-in budget
+  (`jaxpr_budget.json`) — the executable-bloat guard (a 152 MB
+  jit_step once shipped because a bin matrix became a constant).
+
+Also hosts the `_OBJ_FOLD_ATTRS` exhaustiveness audit (ADVICE r5
+item 3): a static scan proving no objective class stores a device
+array outside the fused step's rebind list.
+
+Importing this module imports jax; run on CPU with
+`--xla_force_host_platform_device_count=8` (the CLI sets this up).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from collections import Counter
+from pathlib import Path
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
+
+_BUDGET_PATH = Path(__file__).with_name("jaxpr_budget.json")
+# a fresh entry's budget = ceil(current size * this headroom)
+_BUDGET_HEADROOM = 1.25
+
+_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback", "outside_call",
+}
+
+
+class JaxprSummary(NamedTuple):
+    prim_counts: Dict[str, int]
+    eqn_count: int
+    dtypes: frozenset
+    # operand dtype of every reduce_scatter eqn (the collective wire)
+    wire_dtypes: tuple
+
+
+class Contract(NamedTuple):
+    name: str
+    ok: bool
+    detail: str
+
+
+class AuditResult(NamedTuple):
+    name: str
+    ok: bool
+    contracts: List[Contract]
+    eqn_count: int
+
+    def format(self) -> str:
+        head = "PASS" if self.ok else "FAIL"
+        lines = [f"[{head}] {self.name} ({self.eqn_count} eqns)"]
+        for c in self.contracts:
+            mark = "ok " if c.ok else "XX "
+            lines.append(f"    {mark}{c.name}: {c.detail}")
+        return "\n".join(lines)
+
+
+def _jaxpr_types():
+    """(ClosedJaxpr, Jaxpr) across jax versions: jax.core on 0.4.x,
+    jax.extend.core where the old aliases were removed."""
+    import jax
+
+    for mod in (getattr(jax, "core", None),
+                getattr(getattr(jax, "extend", None), "core", None)):
+        if mod is not None and hasattr(mod, "ClosedJaxpr"):
+            return mod.ClosedJaxpr, mod.Jaxpr
+    raise RuntimeError("cannot locate jax ClosedJaxpr/Jaxpr types")
+
+
+def summarize(closed) -> JaxprSummary:
+    """Flatten a ClosedJaxpr (recursing into call/control-flow/pallas
+    sub-jaxprs) into the primitive/dtype statistics contracts read."""
+    ClosedJaxpr, Jaxpr = _jaxpr_types()
+    prims: Counter = Counter()
+    dtypes: set = set()
+    wire: List[str] = []
+
+    def walk(jaxpr) -> None:
+        for eqn in jaxpr.eqns:
+            prims[eqn.primitive.name] += 1
+            for v in list(eqn.invars) + list(eqn.outvars):
+                dt = getattr(getattr(v, "aval", None), "dtype", None)
+                if dt is not None:
+                    dtypes.add(str(dt))
+            if eqn.primitive.name == "reduce_scatter":
+                wire.append(str(eqn.invars[0].aval.dtype))
+            for p in eqn.params.values():
+                for sub in (p if isinstance(p, (list, tuple)) else [p]):
+                    if isinstance(sub, ClosedJaxpr):
+                        walk(sub.jaxpr)
+                    elif isinstance(sub, Jaxpr):
+                        walk(sub)
+
+    walk(closed.jaxpr)
+    return JaxprSummary(
+        dict(prims), sum(prims.values()), frozenset(dtypes), tuple(wire)
+    )
+
+
+# ---------------------------------------------------------------- contracts
+ContractFn = Callable[[JaxprSummary], Contract]
+
+
+def has_prim(name: str, why: str = "") -> ContractFn:
+    def check(s: JaxprSummary) -> Contract:
+        n = s.prim_counts.get(name, 0)
+        return Contract(
+            f"has_{name}", n > 0,
+            f"{n} {name} eqn(s)" + (f" — {why}" if why else ""),
+        )
+    return check
+
+
+def lacks_prim(name: str, why: str = "") -> ContractFn:
+    def check(s: JaxprSummary) -> Contract:
+        n = s.prim_counts.get(name, 0)
+        return Contract(
+            f"no_{name}", n == 0,
+            (f"absent" if n == 0 else f"{n} present")
+            + (f" — {why}" if why else ""),
+        )
+    return check
+
+
+def wire_int32() -> ContractFn:
+    """Every reduce_scatter operand is integer-typed: the quantized
+    histogram wire must never widen to f32/f64 before the collective."""
+    def check(s: JaxprSummary) -> Contract:
+        bad = [d for d in s.wire_dtypes if not d.startswith(("int", "uint"))]
+        return Contract(
+            "wire_int32", not bad,
+            f"wire dtypes {list(s.wire_dtypes)}"
+            + (f" — non-integer: {bad}" if bad else ""),
+        )
+    return check
+
+
+def no_host_callbacks() -> ContractFn:
+    def check(s: JaxprSummary) -> Contract:
+        found = {
+            k: v for k, v in s.prim_counts.items() if k in _CALLBACK_PRIMS
+        }
+        return Contract(
+            "no_host_callbacks", not found,
+            "none" if not found else f"host callbacks in trace: {found}",
+        )
+    return check
+
+
+def no_f64() -> ContractFn:
+    def check(s: JaxprSummary) -> Contract:
+        bad = sorted(d for d in s.dtypes if "64" in d and d != "int64")
+        return Contract(
+            "no_f64", not bad,
+            "f32/int32 end to end" if not bad else f"widened dtypes: {bad}",
+        )
+    return check
+
+
+def within_budget(budget: Optional[int]) -> ContractFn:
+    def check(s: JaxprSummary) -> Contract:
+        if budget is None:
+            return Contract(
+                "eqn_budget", False,
+                f"{s.eqn_count} eqns but no checked-in budget — run "
+                "`python -m lightgbm_tpu.analysis --update-budget`",
+            )
+        return Contract(
+            "eqn_budget", s.eqn_count <= budget,
+            f"{s.eqn_count} eqns <= budget {budget}"
+            if s.eqn_count <= budget
+            else f"{s.eqn_count} eqns EXCEEDS budget {budget} "
+            "(executable bloat — did a constant get baked in, or a "
+            "loop unroll?)",
+        )
+    return check
+
+
+def audit_jaxpr(closed, contracts: Sequence[ContractFn],
+                name: str = "adhoc") -> AuditResult:
+    """Run contracts against an already-built ClosedJaxpr (tests use
+    this to prove each contract red-to-green on broken fixtures)."""
+    s = summarize(closed)
+    results = [c(s) for c in contracts]
+    return AuditResult(
+        name, all(c.ok for c in results), results, s.eqn_count
+    )
+
+
+# ---------------------------------------------------------------- entries
+def _mesh8():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "jaxpr audit needs a multi-device mesh; run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(python -m lightgbm_tpu.analysis sets this up)"
+        )
+    return Mesh(np.asarray(devs), ("data",))
+
+
+def _trace_rounds_dp(quant: bool, levels: int, local_rows: int):
+    """Abstract shard_map trace of the rounds grower over the data
+    mesh — the exact wiring DataParallelGrower builds (shapes only; no
+    arrays exist, so `local_rows` can model pod scale for free)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..config import Config
+    from ..learner.grower import GrowerSpec, make_split_params
+    from ..learner.rounds import grow_tree_rounds
+    from ..parallel.data_parallel import (
+        _tree_arrays_structure,
+        shard_map_compat,
+    )
+
+    mesh = _mesh8()
+    n = int(mesh.devices.size)
+    L, B, G = 31, 64, 8
+    N = local_rows * n
+    spec = GrowerSpec(
+        num_leaves=L, num_bins=B, max_depth=-1, axis_name="data",
+        axis_size=n, rounds_slots=8, quant=quant,
+        quant_levels=levels if quant else 0, has_cat=False,
+    )
+    params = make_split_params(Config({}))
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+
+    def fn(bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+           feat_mask, params, gh_scale):
+        return grow_tree_rounds(
+            bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+            feat_mask, params, spec,
+            gh_scale=gh_scale if quant else None,
+        )
+
+    row, rep = P("data"), P()
+    sm = shard_map_compat(
+        fn, mesh=mesh,
+        in_specs=(P(None, "data"), rep, rep, rep, rep, row, row, row,
+                  rep, rep, rep),
+        out_specs=(
+            jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)),
+            row,
+        ),
+        check_vma=False,
+    )
+    return jax.make_jaxpr(sm)(
+        mk((G, N), jnp.int32), mk((G,), jnp.int32), mk((G,), jnp.int32),
+        mk((G,), jnp.int32), mk((G,), jnp.bool_), mk((N,), jnp.float32),
+        mk((N,), jnp.float32), mk((N,), jnp.float32), mk((G,), jnp.bool_),
+        params, mk((2,), jnp.float32),
+    )
+
+
+def _trace_rounds_serial():
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import Config
+    from ..learner.grower import GrowerSpec, make_split_params
+    from ..learner.rounds import grow_tree_rounds
+
+    L, B, G, N = 31, 64, 8, 4096
+    spec = GrowerSpec(num_leaves=L, num_bins=B, max_depth=-1,
+                      rounds_slots=8, has_cat=False)
+    params = make_split_params(Config({}))
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+    return jax.make_jaxpr(
+        lambda b, nb, numb, mono, cat, g, h, m, fm, p: grow_tree_rounds(
+            b, nb, numb, mono, cat, g, h, m, fm, p, spec
+        )
+    )(
+        mk((G, N), jnp.int32), mk((G,), jnp.int32), mk((G,), jnp.int32),
+        mk((G,), jnp.int32), mk((G,), jnp.bool_), mk((N,), jnp.float32),
+        mk((N,), jnp.float32), mk((N,), jnp.float32), mk((G,), jnp.bool_),
+        params,
+    )
+
+
+def _trace_hist_round():
+    """The fused partition+histogram pallas kernel (_round_kernel) —
+    traced abstractly; pallas_call jaxpr construction is platform-free
+    even though compilation needs a TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..learner.histogram import HIST_BLK, hist_round
+
+    S, G, B, N = 8, 8, 64, HIST_BLK * 2
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+    return jax.make_jaxpr(
+        lambda b, g, p, prm, coh: hist_round(
+            b, g, p, prm, coh, S, B, quant=True
+        )
+    )(
+        mk((G, N), jnp.int32), mk((8, N), jnp.float32), mk((N,), jnp.int32),
+        mk((S, 16), jnp.int32), mk((S, G), jnp.float32),
+    )
+
+
+class _Entry(NamedTuple):
+    builder: Callable[[], Any]
+    contracts: Callable[[Optional[int]], List[ContractFn]]
+    doc: str
+
+
+# levels=16, 2048 local rows: 2048*8*16 = 262k < 2^31 and 2048*16 =
+# 32k < 2^24 — the rs wire must engage
+_RS_OK = dict(quant=True, levels=16, local_rows=2048)
+# levels=256, 131072 local rows: 131072*256 = 33.5M > 2^24 — the
+# per-shard exactness bound trips and the wire must fall back to psum
+_RS_OVERFLOW = dict(quant=True, levels=256, local_rows=131072)
+
+ENTRIES: Dict[str, _Entry] = {
+    "rounds_quant_rs": _Entry(
+        lambda: _trace_rounds_dp(**_RS_OK),
+        lambda budget: [
+            has_prim("reduce_scatter",
+                     "the int32 histogram wire (bin.h:63-81)"),
+            wire_int32(),
+            no_host_callbacks(),
+            no_f64(),
+            within_budget(budget),
+        ],
+        "quantized data-parallel grower inside the exactness bounds: "
+        "int32 reduce-scatter wire end to end",
+    ),
+    "rounds_quant_rs_overflow": _Entry(
+        lambda: _trace_rounds_dp(**_RS_OVERFLOW),
+        lambda budget: [
+            lacks_prim("reduce_scatter",
+                       "past 2^24 per-shard the int32 wire would be "
+                       "inexact; rs_exact_ok must disable it"),
+            has_prim("psum", "the f32 fallback wire"),
+            no_host_callbacks(),
+        ],
+        "quantized grower past the exactness bound: overflow gate "
+        "engaged, f32 psum fallback",
+    ),
+    "rounds_serial": _Entry(
+        _trace_rounds_serial,
+        lambda budget: [
+            no_host_callbacks(),
+            no_f64(),
+            lacks_prim("reduce_scatter", "no mesh, no collective"),
+            within_budget(budget),
+        ],
+        "single-device rounds grower: pure device loop",
+    ),
+    "hist_round_fused": _Entry(
+        _trace_hist_round,
+        lambda budget: [
+            has_prim("pallas_call", "the fused _round_kernel"),
+            no_host_callbacks(),
+            no_f64(),
+            within_budget(budget),
+        ],
+        "fused partition+histogram kernel (pallas_hist._round_kernel)",
+    ),
+}
+
+
+# ------------------------------------------------------- fold-attr audit
+def audit_fold_attrs() -> AuditResult:
+    """_OBJ_FOLD_ATTRS exhaustiveness (ADVICE r5 item 3): statically
+    prove no objective class assigns a device array to an attribute
+    outside the fused step's rebind list — an unlisted one would be
+    baked into the memoized executable and silently shared across cv
+    folds. Pure AST; no jax import."""
+    import ast
+
+    from .. import objectives as _obj_mod
+    from ..boosting import _OBJ_FOLD_ATTRS, _OBJ_FOLD_EXEMPT
+
+    src = Path(_obj_mod.__file__).read_text()
+    tree = ast.parse(src)
+
+    def is_device_expr(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                parts: List[str] = []
+                f = n.func
+                while isinstance(f, ast.Attribute):
+                    parts.append(f.attr)
+                    f = f.value
+                if isinstance(f, ast.Name):
+                    parts.append(f.id)
+                d = ".".join(reversed(parts))
+                if d.startswith("jnp.") or d.startswith("jax.numpy."):
+                    return True
+                if d in ("jax.device_put",) or d.startswith("jax.random."):
+                    return True
+        return False
+
+    device_attrs: Dict[str, int] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t = n.targets[0]
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and is_device_expr(n.value)
+            ):
+                device_attrs.setdefault(t.attr, n.lineno)
+    unlisted = {
+        a: ln for a, ln in sorted(device_attrs.items())
+        if a not in _OBJ_FOLD_ATTRS and a not in _OBJ_FOLD_EXEMPT
+    }
+    ok = not unlisted
+    detail = (
+        f"device attrs {sorted(device_attrs)} all in _OBJ_FOLD_ATTRS "
+        f"(+exempt {sorted(_OBJ_FOLD_EXEMPT)})"
+        if ok
+        else "objective attrs hold device arrays OUTSIDE the fused "
+        "rebind list (would silently share fold data across cached "
+        "steps): "
+        + ", ".join(f"{a} (objectives.py:{ln})" for a, ln in unlisted.items())
+        + " — add to _OBJ_FOLD_ATTRS or _OBJ_FOLD_EXEMPT (with a "
+        "gating reason)"
+    )
+    return AuditResult(
+        "obj_fold_attrs", ok,
+        [Contract("fold_attrs_exhaustive", ok, detail)], 0,
+    )
+
+
+# ------------------------------------------------------------------ runner
+def load_budgets() -> Dict[str, int]:
+    if _BUDGET_PATH.exists():
+        return {
+            k: int(v) for k, v in json.loads(_BUDGET_PATH.read_text()).items()
+        }
+    return {}
+
+
+def run_audits(names: Optional[Sequence[str]] = None,
+               update_budget: bool = False) -> List[AuditResult]:
+    if names is not None:
+        unknown = set(names) - set(ENTRIES) - {"obj_fold_attrs"}
+        if unknown:
+            # a typoed entry name must not pass vacuously ("no silent
+            # caps" — same posture as within_budget failing on a
+            # missing budget)
+            raise KeyError(
+                f"unknown audit entr{'y' if len(unknown) == 1 else 'ies'} "
+                f"{sorted(unknown)}; known: "
+                f"{sorted(ENTRIES) + ['obj_fold_attrs']}"
+            )
+    budgets = load_budgets()
+    out: List[AuditResult] = []
+    new_budgets = dict(budgets)
+    for name, entry in ENTRIES.items():
+        if names is not None and name not in names:
+            continue
+        closed = entry.builder()
+        s = summarize(closed)
+        if update_budget:
+            new_budgets[name] = int(math.ceil(s.eqn_count * _BUDGET_HEADROOM))
+        contracts = entry.contracts(new_budgets.get(name))
+        results = [c(s) for c in contracts]
+        out.append(AuditResult(
+            name, all(c.ok for c in results), results, s.eqn_count
+        ))
+    if names is None or "obj_fold_attrs" in (names or ()):
+        out.append(audit_fold_attrs())
+    if update_budget:
+        _BUDGET_PATH.write_text(
+            json.dumps(new_budgets, indent=2, sort_keys=True) + "\n"
+        )
+    return out

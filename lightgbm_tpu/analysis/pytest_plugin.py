@@ -1,0 +1,36 @@
+"""Pytest plugin: trace-safety fixtures for any suite using this
+package. Opt-in (NOT a pytest11 entry point — auto-load would tax
+every pytest run in the venv with the full package+jax import): run
+`pytest -p lightgbm_tpu.analysis.pytest_plugin`, or declare
+`pytest_plugins = ["lightgbm_tpu.analysis.pytest_plugin"]` in a root
+conftest. The in-repo tests import these fixtures from conftest.py.
+
+- `retrace_guard`: factory for the jit-cache-miss guard
+  (analysis/retrace.py), with `jax.checking_leaks` opt-in.
+- `jaxpr_audit`: run named invariant audits inline and assert green.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+
+@pytest.fixture
+def retrace_guard():
+    from .retrace import retrace_guard as guard
+
+    return guard
+
+
+@pytest.fixture
+def jaxpr_audit():
+    """fixture(names=None) -> list[AuditResult], asserting all green."""
+    from .jaxpr_audit import run_audits
+
+    def run(names=None):
+        results = run_audits(names=names)
+        bad = [r.format() for r in results if not r.ok]
+        assert not bad, "\n".join(bad)
+        return results
+
+    return run

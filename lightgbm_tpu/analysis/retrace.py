@@ -1,0 +1,161 @@
+"""Retrace guard: fail fast on unexpected jit recompiles + tracer leaks.
+
+Every perf regression class this repo has hit so far — executable
+bloat, fused-step cache staleness, per-iteration retraces from an
+unhashable static or a drifting shape — shows up FIRST as an
+unexpected jit cache miss. This module counts them:
+
+- globally, through a `jax.monitoring` duration-event listener
+  (`/jax/core/compile/jaxpr_trace_duration` fires once per trace,
+  `backend_compile_duration` once per XLA compile);
+- per entry point, through the `_cache_size()` of jitted callables.
+
+`retrace_guard` is a context manager; `tests/conftest.py` wires it in
+as the `retrace_guard` pytest fixture. `jax.checking_leaks` (tracer
+leak detection) can be enabled on the same guard.
+
+    with retrace_guard(entry_points=[grow_tree_rounds], max_retraces=1):
+        train_two_iterations()   # second iteration must reuse the trace
+
+Counting only happens while at least one guard is active, so the
+module-level listener (jax.monitoring has no unregister) costs nothing
+when unused.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+
+class RetraceError(AssertionError):
+    """An entry point retraced (or the process compiled) more than the
+    guard allows."""
+
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_active_guards = 0
+_counters: Dict[str, int] = {_TRACE_EVENT: 0, _COMPILE_EVENT: 0}
+
+
+def _listener(event: str, duration: float, **kwargs: Any) -> None:
+    if _active_guards <= 0:
+        return
+    if event in _counters:
+        with _lock:
+            _counters[event] += 1
+
+
+def _install() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax
+
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def _cache_size(fn: Any) -> Optional[int]:
+    """Trace-cache entry count of a jitted callable (None if the
+    callable exposes no cache — plain functions pass through)."""
+    size = getattr(fn, "_cache_size", None)
+    if callable(size):
+        try:
+            return int(size())
+        except Exception:  # noqa: BLE001 — cache introspection only
+            return None
+    return None
+
+
+class GuardReport:
+    """Mutable result the context manager fills at exit."""
+
+    def __init__(self) -> None:
+        self.traces = 0
+        self.compiles = 0
+        self.per_entry: Dict[str, int] = {}
+
+    def __repr__(self) -> str:
+        return (
+            f"GuardReport(traces={self.traces}, compiles={self.compiles}, "
+            f"per_entry={self.per_entry})"
+        )
+
+
+@contextlib.contextmanager
+def retrace_guard(
+    entry_points: Sequence[Any] = (),
+    max_retraces: int = 0,
+    check_leaks: bool = False,
+    what: str = "guarded region",
+) -> Iterator[GuardReport]:
+    """Fail with RetraceError when jit caches miss more than allowed.
+
+    entry_points: jitted callables — each one's `_cache_size()` may
+        grow by at most `max_retraces` inside the guard. With no entry
+        points, the GLOBAL trace count is bounded instead (any jit
+        tracing anywhere counts, including first-call traces — use
+        entry points after a warmup call for precise contracts).
+    check_leaks: also run the body under `jax.checking_leaks()` so
+        tracers escaping a trace raise immediately. The leak-check
+        config is part of the jit cache key, so cached entry points
+        RETRACE by design under it — raise max_retraces accordingly
+        when combining it with entry_points.
+    """
+    import jax
+
+    global _active_guards
+    _install()
+    report = GuardReport()
+    names: List[str] = []
+    before_entry: List[Optional[int]] = []
+    for fn in entry_points:
+        names.append(getattr(fn, "__name__", repr(fn)))
+        before_entry.append(_cache_size(fn))
+    with _lock:
+        before = dict(_counters)
+        _active_guards += 1
+    try:
+        ctx = jax.checking_leaks() if check_leaks else contextlib.nullcontext()
+        with ctx:
+            yield report
+    finally:
+        with _lock:
+            _active_guards -= 1
+            report.traces = _counters[_TRACE_EVENT] - before[_TRACE_EVENT]
+            report.compiles = (
+                _counters[_COMPILE_EVENT] - before[_COMPILE_EVENT]
+            )
+    offenders: List[str] = []
+    for fn, name, b in zip(entry_points, names, before_entry):
+        after = _cache_size(fn)
+        if b is None or after is None:
+            continue
+        grew = after - b
+        report.per_entry[name] = grew
+        if grew > max_retraces:
+            offenders.append(
+                f"{name}: {grew} new trace-cache entr"
+                f"{'y' if grew == 1 else 'ies'} (allowed {max_retraces})"
+            )
+    # checking_leaks alters the trace-context cache key, forcing fresh
+    # traces by design — the global bound only means something without it
+    if not entry_points and not check_leaks \
+            and report.traces > max_retraces:
+        offenders.append(
+            f"global: {report.traces} jaxpr traces "
+            f"(allowed {max_retraces})"
+        )
+    if offenders:
+        raise RetraceError(
+            f"unexpected retrace in {what}: " + "; ".join(offenders)
+            + " — a shape/dtype/static argument is drifting between "
+            "calls, or a traced value is used as a cache key"
+        )

@@ -249,22 +249,35 @@ class Dataset:
             path = str(self.data)
             fp = {_ra(k): v for k, v in self.params.items()}
             cfg_file = Config(self.params)
-            # two_round streaming (dataset_loader.cpp:210): explicit
-            # config, or automatic above 1 GB of text — host memory
-            # stays O(chunk) + the binned matrix instead of O(file).
-            # Ineligible cases fall through to the whole-file loader:
-            # linear_tree (needs raw values), reference= datasets (must
-            # bin with the TRAINING set's mappers), constructor-level
-            # categorical_feature (column names unknown pre-parse).
+            # two_round streaming (dataset_loader.cpp:210): EXPLICIT
+            # config only, matching the reference (it streams only on
+            # two_round=true) — host memory stays O(chunk) + the binned
+            # matrix instead of O(file). Streamed bin boundaries come
+            # from reservoir-sampled rows, so auto-switching at a size
+            # threshold would silently change model output when a file
+            # crosses 1 GB (ADVICE r5 low); large files get a warning
+            # instead. Ineligible cases fall through to the whole-file
+            # loader: linear_tree (needs raw values), reference=
+            # datasets (must bin with the TRAINING set's mappers),
+            # constructor-level categorical_feature (column names
+            # unknown pre-parse).
             stream_ok = (
                 not is_binary_file(path)
                 and not cfg_file.linear_tree
                 and self.reference is None
                 and self.categorical_feature in ("auto", None, "")
             )
-            want_stream = cfg_file.two_round or (
-                stream_ok and os.path.getsize(path) > (1 << 30)
-            )
+            want_stream = cfg_file.two_round
+            if (not want_stream and stream_ok
+                    and os.path.getsize(path) > (1 << 30)):
+                log.warning(
+                    f"text file {path} is over 1 GB; pass two_round="
+                    "true to stream it with bounded host memory. Note "
+                    "the streamed path bins from reservoir-sampled "
+                    "rows, so results may differ slightly from the "
+                    "whole-file loader (parity deviation documented in "
+                    "docs/DESIGN_DECISIONS.md)."
+                )
             if want_stream and not stream_ok:
                 log.warning(
                     "two_round streaming skipped: linear_tree / "
@@ -275,10 +288,6 @@ class Dataset:
                 from .parsers import load_text_file_two_round
 
                 with _gt.scope("dataset construct (two_round stream)"):
-                    if not cfg_file.two_round:
-                        log.info(
-                            "large text file: streaming two_round load"
-                        )
                     res = load_text_file_two_round(
                         path, cfg_file,
                         header=str(fp.get("header", "false")).lower()

@@ -62,6 +62,48 @@ _FUSED_STEP_CACHE_MAX = 8
 # that varies per fold must gate memo_ok instead).
 _OBJ_FOLD_ATTRS = ("label", "weight", "_label_weight", "_pos_w", "_neg_w")
 
+# device-array objective attributes OUTSIDE the rebind list that are
+# legitimately excluded, each with the gate that keeps the fused memo
+# safe. Everything else holding a jax.Array fails _audit_fold_attrs
+# loudly (ADVICE r5 item 3); the static twin of this check is
+# analysis/jaxpr_audit.audit_fold_attrs.
+_OBJ_FOLD_EXEMPT = {
+    "_pos_biases": "lambdarank position debiasing sets has_host_state, "
+                   "which makes the booster fused-ineligible entirely",
+}
+
+
+def _audit_fold_attrs(objective) -> None:
+    """Build-time assertion: a fold-varying device array outside
+    _OBJ_FOLD_ATTRS would be baked into the memoized fused step as a
+    constant and silently reuse another booster's fold data. Fail
+    loudly instead — run only when memo_ok (the cache-sharing case).
+    Scans pytree LEAVES so device arrays hiding inside containers
+    (tuples, dicts, NamedTuples) are caught too."""
+    import jax
+
+    def holds_device_array(v) -> bool:
+        return any(
+            isinstance(leaf, jax.Array)
+            for leaf in jax.tree_util.tree_leaves(v)
+        )
+
+    extra = sorted(
+        a for a, v in vars(objective).items()
+        if a not in _OBJ_FOLD_ATTRS
+        and a not in _OBJ_FOLD_EXEMPT
+        and holds_device_array(v)
+    )
+    if extra:
+        log.fatal(
+            f"objective {type(objective).__name__} holds device-array "
+            f"attribute(s) {extra} outside _OBJ_FOLD_ATTRS: the fused "
+            "step memo would bake them into a cached executable and "
+            "share them across cv folds / repeated trains. Add them to "
+            "_OBJ_FOLD_ATTRS (rebind per fold) or _OBJ_FOLD_EXEMPT "
+            "(with the gate that makes the memo safe)."
+        )
+
 
 class _EvalNames(NamedTuple):
     names: List[str]
@@ -1187,6 +1229,10 @@ class GBDT:
             and not getattr(self.strategy, "by_query", False)
             and self._dp is None
         )
+        if memo_ok:
+            # the memoized executable outlives this booster — every
+            # fold-varying device attr must be in the rebind list
+            _audit_fold_attrs(objective)
         closure_evals = None
         if not memo_ok:
             closure_evals = [
@@ -1280,7 +1326,8 @@ class GBDT:
             eval_scores = ([score] if track_train_eval else []) + list(vscores)
             rows = [f(s) for f, s in zip(evals, eval_scores)]
             eval_row = (
-                jnp.concatenate(rows) if rows else jnp.zeros(0, jnp.float32)
+                # `rows` is a host list: truthiness = len, not a tracer
+                jnp.concatenate(rows) if rows else jnp.zeros(0, jnp.float32)  # lint: allow[tracer-branch]
             )
             new_state = {
                 "score": score,
@@ -1336,7 +1383,14 @@ class GBDT:
                 _FUSED_STEP_CACHE.move_to_end(key)  # LRU touch
                 self._f_step = cached
                 return
-        self._f_step = jax.jit(step, donate_argnums=(0,))
+        # donate the loop state on accelerators (scores are the big
+        # per-iteration buffers); NOT on CPU — XLA:CPU donation has
+        # produced heap corruption under this runtime (malloc-internal
+        # segfaults mid-suite, always under a fused_dispatch frame —
+        # the documented VERDICT r5 item 5 fragility), and CPU runs are
+        # tests/CI where the extra score copy is noise
+        donate = () if jax.default_backend() == "cpu" else (0,)
+        self._f_step = jax.jit(step, donate_argnums=donate)
         if key is not None:
             _FUSED_STEP_CACHE[key] = self._f_step
             while len(_FUSED_STEP_CACHE) > _FUSED_STEP_CACHE_MAX:

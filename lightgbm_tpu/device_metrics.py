@@ -55,6 +55,15 @@ def _make_pointwise(name: str, cfg: Config, label, w):
         return lambda s: jnp.sqrt(_wmean((s - label) ** 2, w))
     if name == "l1":
         return lambda s: _wmean(jnp.abs(s - label), w)
+    if name == "r2":
+
+        def _r2(s):
+            ybar = _wmean(label, w)
+            ss_res = jnp.sum(w * (label - s) ** 2)
+            ss_tot = jnp.sum(w * (label - ybar) ** 2)
+            return jnp.where(ss_tot > 0, 1.0 - ss_res / ss_tot, 0.0)
+
+        return _r2
     if name == "quantile":
         a = cfg.alpha
 
@@ -339,8 +348,8 @@ def supported_names(metric_objs) -> Optional[Tuple[List[str], List[bool]]]:
     the host metric's eval() tuples."""
     names, hb = [], []
     _ok = {
-        "l2", "rmse", "l1", "quantile", "huber", "fair", "poisson", "mape",
-        "gamma", "gamma_deviance", "tweedie", "binary_logloss",
+        "l2", "rmse", "l1", "r2", "quantile", "huber", "fair", "poisson",
+        "mape", "gamma", "gamma_deviance", "tweedie", "binary_logloss",
         "binary_error", "cross_entropy", "auc", "multi_logloss",
         "multi_error", "ndcg", "map",
     }

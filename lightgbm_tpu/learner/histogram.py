@@ -284,6 +284,31 @@ def int8_oh_shift(n_rows: int, quant_levels: int) -> Optional[int]:
     return None
 
 
+def rs_exact_ok(local_rows: int, n_ranks: int, quant_levels: int) -> bool:
+    """Worst-case exactness bound for the int32 reduce-scatter wire
+    (ADVICE r5 medium; same policy shape as int8_oh_shift).
+
+    The rs wire ships per-rank integer histogram sums as int32 and the
+    'quantized sums are exact, the wire is lossless' claim needs BOTH:
+
+    - global: the mesh-wide hessian-channel cell sum reaches
+      local_rows * n_ranks * quant_levels, which must stay under 2^31
+      or the int32 reduction wraps silently (~8.4M global rows at 256
+      levels — exactly the pod scale the path targets);
+    - local: each rank accumulates its integer sums in f32 before the
+      astype(int32) cast, so the per-rank worst case must stay within
+      f32's exact-integer range 2^24 or the cast quantizes.
+
+    False sends the caller to the f32 psum fallback (lossy-by-design,
+    like the reference's f32 histogram mode). Static ints only — the
+    decision is a trace-time constant, never a device value."""
+    levels = max(int(quant_levels), 1)
+    return (
+        local_rows * n_ranks * levels < 2 ** 31
+        and local_rows * levels < 2 ** 24
+    )
+
+
 def _round_caps(nat_ch: int) -> tuple:
     """(slot cap, scoped-VMEM budget) for the slot-packed kernels —
     chip-calibrated compile limits shared by hist_nat_slots and the

@@ -216,6 +216,11 @@ def _round_kernel(
     """Fused round step: partition decision + slot-packed histograms
     in ONE data pass (VERDICT r4 item 2).
 
+    Compile-time contracts (no host callbacks, no f64, jaxpr size
+    budget) are enforced by the `hist_round_fused` entry of
+    analysis/jaxpr_audit.py — the trace is audited abstractly on CPU,
+    so kernel drift fails tier-1 before it ever reaches hardware.
+
     The rounds grower's per-round extras — the (G, N) split-column
     select (2.2 ms), the (N, S) membership matmul, the row->leaf
     update and the histogram-slot assignment — all touch the same

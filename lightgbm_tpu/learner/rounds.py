@@ -50,6 +50,7 @@ from .histogram import (
     histogram,
     int8_oh_shift,
     root_sums,
+    rs_exact_ok,
 )
 from .grower import (
     GrowerSpec,
@@ -172,11 +173,18 @@ def grow_tree_rounds(
     # all-gather argmax (SyncUpGlobalBestSplit). Quantized sums are
     # exact integers, so the int32 wire is lossless. Irrelevant on ICI
     # where psum is near-free; 4-8x wire on DCN at pod scale.
+    # exactness gate (ADVICE r5 medium): the int32 wire is only
+    # lossless while the worst-case integer sums fit — global cell sum
+    # under 2^31 (int32 wrap) and per-rank f32 accumulation under 2^24
+    # (exact-integer range) — else fall back to the f32 psum path.
+    # histogram.rs_exact_ok; contract enforced by the jaxpr auditor
+    # (analysis/jaxpr_audit.py rounds_quant_rs / _overflow entries).
     n_rs = spec.axis_size
     use_rs = bool(
         ax is not None and n_rs > 1 and spec.quant
         and not spec.efb and not spec.has_cat and not spec.cat_subset
         and not spec.mono_mode and not per_node
+        and rs_exact_ok(N, n_rs, spec.quant_levels)
     )
     if use_rs:
         Gp = -(-G // n_rs) * n_rs  # feature axis padded to the mesh

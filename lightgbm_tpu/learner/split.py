@@ -291,7 +291,7 @@ def feature_best_gains(
 
 def _best_split_impl(
     hist, sum_g, sum_h, sum_c, num_bins, nan_bin, mono, is_cat, params,
-    feat_mask, cat_subset, parent_output, cmin, cmax,
+    feat_mask, cat_subset: bool, parent_output, cmin, cmax,
     penalty=None, rand_bin=None,
 ):
     _, F, B = hist.shape

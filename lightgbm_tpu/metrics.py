@@ -70,6 +70,30 @@ class RMSEMetric(_PointwiseMetric):
         return [(self.name, float(np.sqrt(mse)), False)]
 
 
+class R2Metric(Metric):
+    """Coefficient of determination (the one member of the reference
+    metric.cpp:21 regression family previously missing here):
+    R^2 = 1 - sum(w * (y - s)^2) / sum(w * (y - ybar_w)^2) with the
+    weighted label mean ybar_w; constant labels yield 0 like the
+    degenerate-denominator convention in sklearn."""
+
+    name = "r2"
+    higher_better = True
+
+    def eval(self, score):
+        y = self.label.astype(np.float64)
+        w = (
+            self.weight.astype(np.float64)
+            if self.weight is not None
+            else np.ones_like(y)
+        )
+        ybar = np.sum(w * y) / np.sum(w)
+        ss_res = np.sum(w * (y - score) ** 2)
+        ss_tot = np.sum(w * (y - ybar) ** 2)
+        val = 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+        return [(self.name, float(val), True)]
+
+
 class L1Metric(_PointwiseMetric):
     name = "l1"
 
@@ -433,6 +457,7 @@ _METRICS: Dict[str, type] = {
     "l2": L2Metric, "mean_squared_error": L2Metric, "mse": L2Metric,
     "regression": L2Metric, "regression_l2": L2Metric,
     "rmse": RMSEMetric, "root_mean_squared_error": RMSEMetric, "l2_root": RMSEMetric,
+    "r2": R2Metric, "r_squared": R2Metric,
     "l1": L1Metric, "mean_absolute_error": L1Metric, "mae": L1Metric,
     "regression_l1": L1Metric,
     "quantile": QuantileMetric,

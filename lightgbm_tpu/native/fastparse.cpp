@@ -358,7 +358,14 @@ int64_t fp_greedy_find_bin(const double* distinct, const int64_t* counts,
   mean_bin_size = rest_bin_cnt > 0
                       ? static_cast<double>(rest_sample_cnt) / rest_bin_cnt
                       : kInf;
-  std::vector<double> uppers(max_bin, kInf), lowers(max_bin, kInf);
+  // max_bin + 1: the loop body writes lowers[bin_cnt] BEFORE the
+  // bin_cnt >= max_bin - 1 break check runs, so with max_bin == 1 the
+  // statement order would write lowers[1] one element past a
+  // max_bin-sized buffer (found by manual bounds review of this file
+  // while hunting a suite heap corruption; the count arithmetic makes
+  // the max_bin==1 write unreachable today, but the ordering is a
+  // heap-overflow trap for any future threshold tweak)
+  std::vector<double> uppers(max_bin + 1, kInf), lowers(max_bin + 1, kInf);
   int64_t bin_cnt = 0;
   lowers[0] = distinct[0];
   int64_t cur = 0;

@@ -26,7 +26,17 @@ from .dataset import BinnedDataset, Metadata
 
 
 class ObjectiveFunction:
-    """Base objective (reference objective_function.h:19)."""
+    """Base objective (reference objective_function.h:19).
+
+    Fold-attr contract (ADVICE r5 item 3): any attribute holding a
+    DEVICE array that varies per dataset/fold and is read inside
+    get_gradients must be listed in boosting._OBJ_FOLD_ATTRS (the
+    fused step rebinds those per fold) or in _OBJ_FOLD_EXEMPT with the
+    gate that keeps the memoized step safe. Both the build-time check
+    (boosting._audit_fold_attrs) and the static auditor
+    (analysis/jaxpr_audit.audit_fold_attrs) fail loudly otherwise —
+    an unlisted attr would be baked into a cached executable and
+    silently share fold data across boosters."""
 
     name = "custom"
     num_class = 1

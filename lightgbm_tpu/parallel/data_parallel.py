@@ -29,6 +29,22 @@ from ..learner.grower import GrowerSpec, TreeArrays, grow_tree
 from ..learner.split import SplitParams
 
 
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check_vma):
+    """jax.shard_map across jax versions: new jax exposes it with a
+    `check_vma` flag; 0.4.x ships jax.experimental.shard_map with the
+    equivalent `check_rep` (and interim versions expose jax.shard_map
+    still taking check_rep — probe the signature, not the version)."""
+    import inspect
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    kw = "check_vma" if "check_vma" in params else "check_rep"
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{kw: check_vma})
+
+
 def make_mesh(devices=None, axis_name: str = "data") -> Mesh:
     """1-D data mesh over all (or given) devices."""
     if devices is None:
@@ -65,7 +81,10 @@ class DataParallelGrower:
                 f"with per-rank feature ownership ({n} ranks) — ~2x "
                 f"less wire per round and 1/{n} the histogram-pool "
                 f"memory vs the f32 full-psum path (bin.h:63-81, "
-                f"data_parallel_tree_learner.cpp:286)"
+                f"data_parallel_tree_learner.cpp:286); engaged only "
+                f"while the worst-case integer sums stay exact "
+                f"(histogram.rs_exact_ok: global < 2^31, per-shard "
+                f"< 2^24), else the f32 psum path"
             )
 
         row = P(axis_name)  # shard the row axis of per-row vectors
@@ -90,7 +109,7 @@ class DataParallelGrower:
                     row, rep, rep, rep, rep, rep, rep)
         out_specs = (jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)), row)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 fn,
                 mesh=mesh,
                 in_specs=in_specs,

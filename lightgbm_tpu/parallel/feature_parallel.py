@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..learner.grower import GrowerSpec, TreeArrays, grow_tree
 from ..learner.split import SplitParams
+from .data_parallel import shard_map_compat
 
 
 class FeatureParallelGrower:
@@ -62,7 +63,7 @@ class FeatureParallelGrower:
         in_specs = (bins_spec, fshard, fshard, fshard, fshard,
                     rep, rep, rep, fshard, rep, rep)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 fn,
                 mesh=mesh,
                 in_specs=in_specs,

@@ -1,0 +1,375 @@
+"""Trace-safety static analysis suite (analysis/): rule fixtures with
+known violations, red-to-green jaxpr contracts, the retrace guard, and
+the strict clean run over the real package — the tier-1 hook that makes
+new lint violations and jaxpr-contract breaks fail the suite."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.analysis.lint import (
+    Finding,
+    RULES,
+    format_findings,
+    lint_package,
+    lint_source,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ----------------------------------------------------------------- lint
+_VIOLATIONS = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+@jax.jit
+def tracer_hazards(x, y):
+    if x > 0:                       # tracer-branch
+        z = float(x)                # tracer-cast
+    q = x > 1 and y > 2             # tracer-branch (short-circuit)
+    w = np.asarray(y)               # np-on-tracer
+    v = x.item()                    # host-sync
+    return x + y
+
+@partial(jax.jit, static_argnames=("n",))
+def static_ok(x, n):
+    if n > 2:                       # static arg: clean
+        x = x + 1
+    G, N = x.shape
+    if N > 4:                       # shape: clean
+        x = x * 2
+    if x is None:                   # identity: clean
+        return x
+    return jnp.sum(x)
+
+def helper(a, flag=False):
+    if flag:                        # literal-default param: clean
+        a = a * 2
+    return bool(a > 0)              # tracer-cast through the call graph
+
+@jax.jit
+def root(x):
+    return helper(x)
+
+def not_traced(q):
+    if q:                           # host code: clean
+        return float(q)
+    return 0.0
+
+def make_baked(base):
+    arr = jnp.asarray(base)
+    def inner(z):
+        return z + arr
+    return jax.jit(inner)           # device-closure
+
+def mut(a, b=[]):                   # mutable-default
+    return a
+'''
+
+
+def _rules_at(findings, rule):
+    return [f for f in findings if f.rule == rule and not f.suppressed]
+
+
+def test_each_rule_fires_on_fixture():
+    fs = lint_source(_VIOLATIONS)
+    assert len(_rules_at(fs, "tracer-branch")) == 2
+    assert len(_rules_at(fs, "tracer-cast")) == 2  # float() + helper bool()
+    assert len(_rules_at(fs, "np-on-tracer")) == 1
+    assert len(_rules_at(fs, "host-sync")) == 1
+    assert len(_rules_at(fs, "device-closure")) == 1
+    assert len(_rules_at(fs, "mutable-default")) == 1
+    # every registered rule is exercised by this fixture
+    assert {f.rule for f in fs} == set(RULES)
+
+
+def test_static_constructs_stay_clean():
+    fs = lint_source(_VIOLATIONS)
+    lines = {f.line for f in fs}
+    src_lines = _VIOLATIONS.splitlines()
+    for i, txt in enumerate(src_lines, start=1):
+        if "clean" in txt:
+            assert i not in lines, f"false positive on line {i}: {txt}"
+
+
+def test_suppression_comment_and_file_allow():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # lint: allow[tracer-cast]\n"
+    )
+    fs = lint_source(src)
+    assert len(fs) == 1 and fs[0].suppressed
+    src2 = (
+        "# lint: allow-file[tracer-cast]\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n"
+    )
+    fs2 = lint_source(src2)
+    assert len(fs2) == 1 and fs2[0].suppressed
+    # an unrelated rule id does NOT suppress
+    src3 = src.replace("tracer-cast", "host-sync")
+    fs3 = lint_source(src3)
+    assert len(fs3) == 1 and not fs3[0].suppressed
+
+
+def test_real_package_is_lint_clean():
+    """The acceptance bar: zero unsuppressed violations over the real
+    package source (intentional sites are annotated, not silenced)."""
+    fs = lint_package(str(REPO / "lightgbm_tpu"))
+    bad = [f for f in fs if not f.suppressed]
+    assert not bad, "\n" + format_findings(bad)
+
+
+def test_format_findings_counts():
+    fs = lint_source(_VIOLATIONS)
+    out = format_findings(fs, show_suppressed=True)
+    assert "violation(s)" in out and "tracer-cast" in out
+
+
+# ----------------------------------------------------- jaxpr contracts
+def _mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), ("data",))
+
+
+def test_wire_int32_red_to_green():
+    """The dtype-widening contract: a deliberately f32-widened
+    reduce-scatter wire FAILS wire_int32; the int32 wire passes."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.analysis.jaxpr_audit import audit_jaxpr, wire_int32
+    from lightgbm_tpu.parallel.data_parallel import shard_map_compat
+
+    mesh = _mesh()
+
+    def make(widen):
+        def f(h):
+            wire = h.astype(jnp.float32) if widen else h.astype(jnp.int32)
+            return lax.psum_scatter(
+                wire, "data", scatter_dimension=0, tiled=True
+            )
+
+        sm = shard_map_compat(f, mesh=mesh, in_specs=(P(None, "data"),),
+                              out_specs=P("data"), check_vma=False)
+        return jax.make_jaxpr(sm)(
+            jax.ShapeDtypeStruct((16, 8), jnp.int32)
+        )
+
+    red = audit_jaxpr(make(widen=True), [wire_int32()], "widened")
+    assert not red.ok, red.format()
+    green = audit_jaxpr(make(widen=False), [wire_int32()], "int32")
+    assert green.ok, green.format()
+
+
+def test_host_callback_contract_red_to_green():
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.analysis.jaxpr_audit import (
+        audit_jaxpr,
+        no_host_callbacks,
+    )
+
+    def dirty(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((4,), jnp.float32), x,
+        )
+
+    red = audit_jaxpr(
+        jax.make_jaxpr(dirty)(jax.ShapeDtypeStruct((4,), jnp.float32)),
+        [no_host_callbacks()], "callback",
+    )
+    assert not red.ok
+    green = audit_jaxpr(
+        jax.make_jaxpr(lambda x: x * 2)(
+            jax.ShapeDtypeStruct((4,), jnp.float32)
+        ),
+        [no_host_callbacks()], "clean",
+    )
+    assert green.ok
+
+
+def test_eqn_budget_contract_red_to_green():
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.analysis.jaxpr_audit import audit_jaxpr, within_budget
+
+    closed = jax.make_jaxpr(lambda x: jnp.sin(x) + jnp.cos(x) * 2)(
+        jax.ShapeDtypeStruct((4,), jnp.float32)
+    )
+    assert not audit_jaxpr(closed, [within_budget(1)], "tiny").ok
+    assert audit_jaxpr(closed, [within_budget(100)], "roomy").ok
+    # a missing checked-in budget is a FAILURE, not a skip
+    assert not audit_jaxpr(closed, [within_budget(None)], "nobudget").ok
+
+
+def test_rs_exact_ok_bounds():
+    """The overflow/exactness gate (ADVICE r5 medium) as pure policy:
+    global rows * levels < 2^31 AND local rows * levels < 2^24."""
+    from lightgbm_tpu.learner.histogram import rs_exact_ok
+
+    assert rs_exact_ok(2048, 8, 16)
+    # local bound: rows * levels hits exactly 2^24 -> inexact f32 cast
+    assert rs_exact_ok(2 ** 16 - 1, 8, 256)  # 16776960 < 2^24: ok
+    assert not rs_exact_ok(2 ** 16, 8, 256)  # 2^24 exactly: gate off
+    # global int32 wrap ISOLATED from the local bound: per-shard sum
+    # 16776960 < 2^24 is fine, but 256 ranks push the global cell sum
+    # to ~4.29e9 > 2^31 — only the global clause can catch this
+    assert not rs_exact_ok(2 ** 16 - 1, 256, 256)
+    # unquantized callers pass levels=0 -> treated as exact counts
+    assert rs_exact_ok(2 ** 20, 8, 0)
+
+
+def test_grower_wire_contracts_end_to_end():
+    """The real entries: inside the bounds the int32 reduce-scatter
+    wire is present end to end; past the per-shard bound the overflow
+    gate removes it and the f32 psum fallback appears. (Red-to-green
+    for the gate: before rounds.py grew rs_exact_ok, the overflow
+    entry traced a reduce_scatter and this test fails.)"""
+    from lightgbm_tpu.analysis.jaxpr_audit import run_audits
+
+    results = {
+        r.name: r
+        for r in run_audits(
+            names=["rounds_quant_rs", "rounds_quant_rs_overflow"]
+        )
+    }
+    ok_entry = results["rounds_quant_rs"]
+    assert ok_entry.ok, ok_entry.format()
+    over = results["rounds_quant_rs_overflow"]
+    assert over.ok, over.format()
+
+
+def test_fold_attr_static_audit_green():
+    from lightgbm_tpu.analysis.jaxpr_audit import audit_fold_attrs
+
+    r = audit_fold_attrs()
+    assert r.ok, r.format()
+
+
+def test_fold_attr_runtime_audit_red_to_green():
+    """A fold-varying device array outside _OBJ_FOLD_ATTRS must fail
+    loudly at fused build time (ADVICE r5 item 3)."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.boosting import _audit_fold_attrs
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.log import LightGBMError
+    from lightgbm_tpu.objectives import create_objective
+
+    obj = create_objective(Config({"objective": "regression"}))
+    obj.label = jnp.zeros(8, jnp.float32)
+    _audit_fold_attrs(obj)  # green: listed attrs only
+    obj._evil_fold_state = jnp.ones(8, jnp.float32)
+    with pytest.raises(LightGBMError, match="_evil_fold_state"):
+        _audit_fold_attrs(obj)
+
+
+# ------------------------------------------------------- retrace guard
+def test_retrace_guard_red_to_green(retrace_guard):
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.analysis.retrace import RetraceError
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    f(jnp.ones(3))  # warmup
+    with retrace_guard(entry_points=[f], what="stable shapes") as rep:
+        f(jnp.ones(3))
+        f(jnp.zeros(3))
+    assert rep.per_entry["f"] == 0
+
+    # deliberately retracing function: every call sees a fresh shape
+    with pytest.raises(RetraceError, match="f: 2 new trace-cache"):
+        with retrace_guard(entry_points=[f], what="drifting shapes"):
+            f(jnp.ones(4))
+            f(jnp.ones(5))
+
+
+def test_retrace_guard_leak_detection(retrace_guard):
+    import jax
+    import jax.numpy as jnp
+
+    leaked = []
+
+    with pytest.raises(Exception, match="[Ll]eak"):
+        with retrace_guard(check_leaks=True):
+
+            @jax.jit
+            def g(x):
+                leaked.append(x)  # tracer escapes the trace
+                return x
+
+            g(jnp.ones(2))
+
+
+def test_grower_trains_without_retrace(retrace_guard):
+    """The training entry point itself: a second identically-shaped
+    tree growth must reuse the first trace (the regression class the
+    guard exists for)."""
+    import jax.numpy as jnp
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.learner.grower import grow_tree
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(400, 5)
+    y = (X @ rs.randn(5) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "tpu_growth_mode": "exact"}
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    lgb.train(params, ds, num_boost_round=2)  # warmup traces everything
+    with retrace_guard(entry_points=[grow_tree], max_retraces=0,
+                       what="repeated identical training"):
+        ds2 = lgb.Dataset(X, label=y, free_raw_data=False)
+        lgb.train(params, ds2, num_boost_round=2)
+
+
+# ----------------------------------------------------- strict CLI hook
+@pytest.mark.slow
+def test_cli_strict_exits_zero():
+    """`python -m lightgbm_tpu.analysis --strict` is the CI hook: a new
+    unsuppressed lint violation or broken jaxpr contract fails it."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis", "--strict"],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analysis: clean" in proc.stdout
+
+
+def test_strict_equivalent_in_process():
+    """The same strict gate, in-process (runs in tier-1 even when the
+    subprocess variant is skipped as slow): zero unsuppressed lint
+    findings AND every jaxpr/fold-attr audit green."""
+    from lightgbm_tpu.analysis.jaxpr_audit import run_audits
+
+    fs = lint_package(str(REPO / "lightgbm_tpu"))
+    assert not [f for f in fs if not f.suppressed], format_findings(fs)
+    results = run_audits()
+    bad = [r.format() for r in results if not r.ok]
+    assert not bad, "\n".join(bad)

@@ -120,3 +120,36 @@ def test_two_round_reference_falls_back_to_train_mappers(tmp_path):
     for a, b in zip(va._binned.mappers, tr._binned.mappers):
         np.testing.assert_array_equal(
             np.asarray(a.upper_bounds), np.asarray(b.upper_bounds))
+
+
+def test_no_auto_stream_above_1gb(tmp_path, monkeypatch, capsys):
+    """Streaming requires EXPLICIT two_round=true (ADVICE r5 low): a
+    text file crossing the 1 GB threshold must NOT silently switch bin
+    boundaries to the reservoir-sampled streamed path — it keeps the
+    whole-file loader and warns about the opt-in."""
+    import os as _os
+
+    p = tmp_path / "data.csv"
+    _write_csv(p, n=4000)
+    real_getsize = _os.path.getsize
+    monkeypatch.setattr(
+        _os.path, "getsize",
+        lambda q: (2 << 30) if str(q) == str(p) else real_getsize(q),
+    )
+    streamed = []
+    import lightgbm_tpu.parsers as parsers
+
+    real_stream = parsers.load_text_file_two_round
+    monkeypatch.setattr(
+        parsers, "load_text_file_two_round",
+        lambda *a, **k: streamed.append(1) or real_stream(*a, **k),
+    )
+    ds = lgb.Dataset(str(p), params={"verbosity": 1})
+    ds.construct()
+    assert not streamed, "auto-enabled streamed two_round without opt-in"
+    err = capsys.readouterr()
+    assert "two_round" in err.err + err.out  # the parity-deviation warning
+    # explicit opt-in still streams
+    ds2 = lgb.Dataset(str(p), params={"two_round": True, "verbosity": -1})
+    ds2.construct()
+    assert streamed

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# CI wiring for the trace-safety static analysis suite
+# (docs/STATIC_ANALYSIS.md). Strict mode: any unsuppressed lint
+# violation or failed jaxpr contract exits nonzero. The python entry
+# point forces jax onto a cpu 8-device mesh itself, so this is safe on
+# hosts whose ambient JAX_PLATFORMS points at real accelerators.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m lightgbm_tpu.analysis --strict "$@"
