@@ -76,8 +76,9 @@ def probe_backend(timeout_s: float, retries: int = 1) -> str:
 
     The axon tunnel wedges transiently (multi-minute init hangs that
     clear on a later attempt — observed rounds 2-4), so a failed probe
-    is retried after a short pause rather than condemning the run to
-    the CPU fallback on first strike."""
+    is retried with exponential backoff (10s, 20s, 40s, ... capped at
+    120s) rather than condemning the run to the CPU fallback on first
+    strike."""
     for attempt in range(1, retries + 1):
         try:
             r = subprocess.run(
@@ -102,12 +103,22 @@ def probe_backend(timeout_s: float, retries: int = 1) -> str:
                 f"[bench] backend probe {attempt}/{retries} failed: {e}\n"
             )
         if attempt < retries:
-            time.sleep(20)
+            backoff = min(10.0 * (2 ** (attempt - 1)), 120.0)
+            sys.stderr.write(f"[bench] retrying probe in {backoff:.0f}s\n")
+            time.sleep(backoff)
     return "cpu"
 
 
 _STATE = {"stage": "init"}
 _FINAL_PRINTED = False
+
+
+def _tpu_verified():
+    """Chip numbers annotated with the ONE staleness rule (shared by
+    the partial and final json so they cannot drift): stale=true when
+    this run did not actually execute on the TPU, so a dead tunnel can
+    no longer ship carried-forward numbers as if fresh."""
+    return dict(LAST_TPU_VERIFIED, stale=_STATE.get("platform") != "tpu")
 
 
 def _final_json():
@@ -123,7 +134,7 @@ def _final_json():
         "vs_baseline": round(tps / baseline_tps, 4) if tps else 0.0,
         "platform": _STATE.get("platform", "unknown"),
         "stage": _STATE.get("stage", "unknown"),
-        "last_tpu_verified": LAST_TPU_VERIFIED,
+        "last_tpu_verified": _tpu_verified(),
     }
     if _STATE.get("quantized_trees_per_sec"):
         out["quantized_vs_baseline"] = round(
@@ -178,7 +189,9 @@ def save_partial(**kw):
     _STATE.update(kw)
     try:
         with open(os.path.join(REPO, "bench_partial.json"), "w") as f:
-            json.dump(dict(_STATE, last_tpu_verified=LAST_TPU_VERIFIED), f)
+            json.dump(
+                dict(_STATE, last_tpu_verified=_tpu_verified()), f
+            )
     except OSError:
         pass
 

@@ -19,6 +19,7 @@ The public Python API mirrors the reference python-package
 ports with an import change.
 """
 
+from . import serving
 from .basic import Booster, Dataset, Sequence, set_network
 from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
 from .engine import CVBooster, cv, train
@@ -63,5 +64,6 @@ __all__ = [
     "plot_metric",
     "plot_tree",
     "create_tree_digraph",
+    "serving",
     "__version__",
 ]

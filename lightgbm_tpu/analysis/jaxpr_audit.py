@@ -322,6 +322,33 @@ def _trace_hist_round():
     )
 
 
+def _trace_serving_forest():
+    """Abstract trace of the serving predictor (serving/forest.py
+    forest_apply) — the scoring entry point's jaxpr from shapes alone:
+    8 trees x 31 nodes, categorical path on, 256 rows x 16 features."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.forest import forest_apply
+
+    T, M, L, W, Ck, K, N, F = 8, 31, 32, 4, 1, 1, 256, 16
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+    tables = {
+        "pack": mk((9, T * M), jnp.float32),
+        "catw": mk((W,), jnp.int32),
+        "leaf_value": mk((T, L), jnp.float32),
+        "leaf_const": mk((T, L), jnp.float32),
+        "leaf_nf": mk((T, L), jnp.int32),
+        "leaf_feat": mk((T, L, Ck), jnp.int32),
+        "leaf_coeff": mk((T, L, Ck), jnp.float32),
+        "init_node": mk((T,), jnp.int32),
+        "class_onehot": mk((T, K), jnp.float32),
+    }
+    return jax.make_jaxpr(
+        lambda t, X, w: forest_apply(t, X, w, has_cat=True, linear=False)
+    )(tables, mk((N, F), jnp.float32), mk((T,), jnp.float32))
+
+
 class _Entry(NamedTuple):
     builder: Callable[[], Any]
     contracts: Callable[[Optional[int]], List[ContractFn]]
@@ -380,6 +407,17 @@ ENTRIES: Dict[str, _Entry] = {
             within_budget(budget),
         ],
         "fused partition+histogram kernel (pallas_hist._round_kernel)",
+    ),
+    "serving_forest": _Entry(
+        _trace_serving_forest,
+        lambda budget: [
+            no_host_callbacks(),
+            no_f64(),
+            has_prim("while", "depth-stepped lockstep traversal"),
+            within_budget(budget),
+        ],
+        "serving predictor (serving/forest.py): f32/int32 scoring "
+        "jaxpr, no callbacks, bounded size",
     ),
 }
 

@@ -937,11 +937,43 @@ class Booster:
         pred_leaf: bool = False,
         pred_contrib: bool = False,
         validate_features: bool = False,
+        device: Optional[str] = None,
         **kwargs: Any,
     ) -> np.ndarray:
         arr, _ = _to_2d_numpy(data)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if device not in (None, "", "cpu", "host"):
+            # TPU-resident scoring (serving.TensorForest): the forest is
+            # packed to device tables and traversed rows x trees under
+            # jit. Tables are rebuilt per call (same posture as
+            # _packed_model: models mutate in place through refit /
+            # set_leaf_output and packing is ~ms); the jitted traversal
+            # itself is shared module-level, so no recompile per call.
+            if pred_contrib:
+                log.warning(
+                    "pred_contrib has no device implementation; using "
+                    "the host SHAP path"
+                )
+            elif kwargs.get("pred_early_stop",
+                            self.params.get("pred_early_stop", False)):
+                log.warning(
+                    "pred_early_stop has no device implementation; "
+                    "using the host predictor"
+                )
+            else:
+                from .serving import TensorForest
+
+                forest = TensorForest.from_booster(self)
+                if pred_leaf:
+                    return forest.predict_leaf(
+                        arr, start_iteration, num_iteration
+                    )
+                raw = forest.predict_raw(arr, start_iteration, num_iteration)
+                g = self._gbdt
+                if not raw_score and g.objective is not None:
+                    raw = g.objective.convert_output(raw)
+                return raw[0] if g.num_class == 1 else raw.T
         if pred_leaf:
             return self._gbdt.predict_leaf_index(arr, start_iteration, num_iteration)
         if pred_contrib:

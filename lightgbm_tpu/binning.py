@@ -204,6 +204,129 @@ def find_bin_bounds(
     return bounds
 
 
+def load_forced_bins(path: str,
+                     num_total_features: Optional[int] = None
+                     ) -> Dict[int, List[float]]:
+    """Parse a forcedbins_filename JSON file (reference
+    src/io/dataset_loader.cpp DatasetLoader::GetForcedBins; example
+    format examples/regression/forced_bins.json): a list of
+    ``{"feature": idx, "bin_upper_bound": [floats]}`` entries ->
+    feature index -> forced upper bounds. Missing file is fatal (an
+    explicitly configured path that silently does nothing is the bug
+    this satellite removes); malformed entries warn and are skipped."""
+    import json
+    import os
+
+    from . import log
+
+    if not path:
+        return {}
+    if not os.path.exists(path):
+        log.fatal(f"forcedbins_filename {path} does not exist")
+    try:
+        entries = json.loads(open(path).read())
+    except json.JSONDecodeError as e:
+        log.fatal(f"forcedbins_filename {path} is not valid JSON: {e}")
+    if not isinstance(entries, list):
+        log.fatal(
+            f"forcedbins_filename {path} must contain a JSON LIST of "
+            '{"feature": idx, "bin_upper_bound": [...]} entries, got '
+            f"{type(entries).__name__}"
+        )
+    out: Dict[int, List[float]] = {}
+    for e in entries:
+        try:
+            f = int(e["feature"])
+            bounds = [float(b) for b in e["bin_upper_bound"]]
+        except (KeyError, TypeError, ValueError):
+            log.warning(f"forced bins entry {e!r} malformed; skipped")
+            continue
+        if num_total_features is not None and not 0 <= f < num_total_features:
+            log.warning(
+                f"forced bins feature {f} out of range "
+                f"[0, {num_total_features}); skipped"
+            )
+            continue
+        if bounds:
+            out[f] = bounds
+    return out
+
+
+def find_bin_bounds_forced(
+    values: np.ndarray,
+    total_sample_cnt: int,
+    max_bin: int,
+    min_data_in_bin: int,
+    forced: Sequence[float],
+) -> List[float]:
+    """Bin bounds honoring forced boundaries (reference bin.cpp
+    FindBinWithPredefinedBin semantics): every forced bound becomes a
+    mandatory bin edge; the remaining budget is split over the
+    inter-bound segments in proportion to their sample mass, with the
+    greedy packer running inside each segment.
+
+    Deviation (documented): the zero-as-one-bin split is bypassed on
+    forced features — the user's explicit boundaries define the
+    partition instead of the automatic +-kZeroThreshold split.
+    """
+    forced_u = sorted({float(b) for b in forced if np.isfinite(b)})
+    if not forced_u:
+        return find_bin_bounds(values, total_sample_cnt, max_bin,
+                               min_data_in_bin)
+    budget = max(max_bin - 1, 1)
+    if len(forced_u) > budget:
+        from . import log
+
+        # an explicitly configured bound must never vanish silently —
+        # same contract as load_forced_bins' malformed-entry warnings
+        log.warning(
+            f"forced bins: {len(forced_u)} bounds exceed the "
+            f"max_bin={max_bin} budget; keeping the {budget} smallest"
+        )
+        forced_u = forced_u[:budget]
+    values = np.asarray(values, np.float64)
+    # sparse sampling omits implicit zeros from `values` (the CSC path
+    # passes explicit entries only); their mass belongs to whichever
+    # segment contains 0.0 — both for budget shares and for the greedy
+    # packer's total/min_data_in_bin accounting
+    zero_cnt = max(int(total_sample_cnt - len(values)), 0)
+    edges = [-np.inf] + forced_u + [np.inf]
+    rest = max(max_bin - len(forced_u), 1)
+    n_total = max(len(values) + zero_cnt, 1)
+    out: List[float] = []
+    for i in range(len(edges) - 1):
+        lo, hi = edges[i], edges[i + 1]
+        seg = values[(values > lo) & (values <= hi)]
+        seg_zero = zero_cnt if (lo < 0.0 <= hi) else 0
+        mass = len(seg) + seg_zero
+        sub = max(1, int(round(rest * mass / n_total)))
+        if mass:
+            dv, cnt = np.unique(seg, return_counts=True)
+            if seg_zero:
+                j = int(np.searchsorted(dv, 0.0))
+                if j < len(dv) and dv[j] == 0.0:
+                    cnt[j] += seg_zero
+                else:
+                    dv = np.insert(dv, j, 0.0)
+                    cnt = np.insert(cnt, j, seg_zero)
+            sb = greedy_find_bin(dv, cnt, sub, mass, min_data_in_bin)
+        else:
+            sb = [float("inf")]
+        if np.isfinite(hi):
+            sb[-1] = hi  # the forced bound closes this segment
+        for b in sb:
+            if not out or not _check_double_equal_ordered(out[-1], b):
+                out.append(b)
+    if not out or not np.isposinf(out[-1]):
+        out.append(float("inf"))
+    if len(out) > max_bin:  # segment rounding overflow: keep forced
+        keep = set(forced_u)
+        extra = [b for b in out[:-1] if b not in keep]
+        extra = extra[: max(max_bin - 1 - len(forced_u), 0)]
+        out = sorted(set(extra) | keep) + [float("inf")]
+    return out
+
+
 @dataclass
 class BinMapper:
     """Per-feature value->bin mapping (reference bin.h:85)."""
@@ -231,12 +354,20 @@ class BinMapper:
         bin_type: BinType = BinType.NUMERICAL,
         min_data_per_group: int = 100,
         max_cat_threshold: int = 32,
+        forced_bounds: Optional[Sequence[float]] = None,
     ) -> "BinMapper":
         values = np.asarray(values, dtype=np.float64).ravel()
         na_cnt = int(np.sum(np.isnan(values)))
         clean = values[~np.isnan(values)]
 
         if bin_type == BinType.CATEGORICAL:
+            if forced_bounds:
+                from . import log
+
+                log.warning(
+                    "forced bins only apply to numerical features; "
+                    "ignored for a categorical feature"
+                )
             return BinMapper._categorical(
                 clean, na_cnt, total_sample_cnt, max_bin, use_missing
             )
@@ -260,12 +391,18 @@ class BinMapper:
                 clean = np.concatenate([clean, np.zeros(na_cnt)])
                 na_cnt = 0
 
-        bounds = find_bin_bounds(
-            clean,
-            total_sample_cnt - (na_cnt if missing_type == MissingType.NAN else 0),
-            eff_max_bin,
-            min_data_in_bin,
+        eff_total = total_sample_cnt - (
+            na_cnt if missing_type == MissingType.NAN else 0
         )
+        if forced_bounds:
+            bounds = find_bin_bounds_forced(
+                clean, eff_total, eff_max_bin, min_data_in_bin,
+                forced_bounds,
+            )
+        else:
+            bounds = find_bin_bounds(
+                clean, eff_total, eff_max_bin, min_data_in_bin,
+            )
         ub = np.asarray(bounds, dtype=np.float64)
         num_bin = len(ub)
         if missing_type == MissingType.NAN:

@@ -277,6 +277,65 @@ def _task_refit(params: Dict[str, str]) -> None:
     log.info(f"Finished the refit task; new model saved to {out}")
 
 
+def _task_serve(params: Dict[str, str]) -> None:
+    """task=serve: load input_model into the serving registry and run
+    the scoring loop (lightgbm_tpu/serving, docs/SERVING.md). With
+    serve_port=0 (default) speaks line-delimited JSON over
+    stdin/stdout — one request per line, one response line each; with
+    serve_port>0 runs the HTTP front end on that port. More models can
+    be loaded/hot-swapped at runtime through the protocol's
+    load/swap/rollback ops."""
+    import jax
+
+    from .config import Config
+    from .serving import ModelRegistry, ScoringServer, serve_http
+
+    t0 = time.time()
+    cfg = Config(dict(params))
+    model_path = params.get("input_model", "LightGBM_model.txt")
+    if not Path(model_path).exists():
+        log.fatal(f"input model {model_path} does not exist")
+    prev_logger = (log._logger, log._info_method, log._warning_method)
+    if cfg.serve_port == 0:
+        # stdio mode: the protocol owns stdout — framework logs move to
+        # stderr BEFORE anything (registry load, mesh setup) can emit,
+        # so an info line can never corrupt a JSON response (restored on
+        # exit: the logger is process-global state and in-process
+        # callers must not inherit the reroute)
+        class _StderrLogger:
+            @staticmethod
+            def info(msg: str) -> None:
+                print(msg, file=sys.stderr, flush=True)
+
+            warning = info
+
+        log.register_logger(_StderrLogger)
+    try:
+        mesh = None
+        if jax.device_count() > 1:
+            from .parallel.data_parallel import make_mesh
+
+            mesh = make_mesh(axis_name=cfg.tpu_mesh_axes.split(",")[0])
+            log.info(
+                f"serving rows sharded over {jax.device_count()} devices"
+            )
+        registry = ModelRegistry(
+            mesh=mesh, buckets=cfg.serve_buckets, warmup=cfg.serve_warmup
+        )
+        registry.load(cfg.serve_model_name, model_path)
+        if cfg.serve_port > 0:
+            serve_http(registry, cfg.serve_port, cfg.serve_host)
+        else:
+            n = ScoringServer(registry).serve(sys.stdin, sys.stdout)
+            print(f"[serve] handled {n} requests", file=sys.stderr)
+        # summary logged HERE, while the stdio reroute is still
+        # registered: in stdio mode the protocol owns stdout to EOF, so
+        # main() must not append its own line after the logger restore
+        log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
+    finally:
+        log._logger, log._info_method, log._warning_method = prev_logger
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     params = parse_kv_args(argv)
@@ -292,7 +351,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not params:
         print(
             "usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
-            "tasks: train (default), predict, save_binary",
+            "tasks: train (default), predict, save_binary, "
+            "convert_model, refit, serve",
             file=sys.stderr,
         )
         return 1
@@ -308,6 +368,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         _task_convert_model(params)
     elif task in ("refit", "refit_tree"):
         _task_refit(params)
+    elif task == "serve":
+        _task_serve(params)  # logs its own protocol-safe summary
+        return 0
     else:
         log.fatal(f"Unknown task {task}")
     log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")

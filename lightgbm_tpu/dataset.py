@@ -160,6 +160,11 @@ class BinnedDataset:
             else:
                 sample = data
             max_bin_by_feature = list(config.max_bin_by_feature)
+            from .binning import load_forced_bins
+
+            forced_map = load_forced_bins(
+                config.forcedbins_filename, num_features
+            )
             mappers = []
             for f in range(num_features):
                 mb = (
@@ -181,6 +186,7 @@ class BinnedDataset:
                         zero_as_missing=config.zero_as_missing,
                         bin_type=BinType.CATEGORICAL if f in cat_set else BinType.NUMERICAL,
                         max_cat_threshold=config.max_cat_threshold,
+                        forced_bounds=forced_map.get(f),
                     )
                 )
             used = np.array(
@@ -314,6 +320,11 @@ class BinnedDataset:
             else:
                 s_csc = csc
             mb_list = list(config.max_bin_by_feature)
+            from .binning import load_forced_bins
+
+            forced_map = load_forced_bins(
+                config.forcedbins_filename, num_features
+            )
             mappers = []
             for f in range(num_features):
                 vals = s_csc.data[s_csc.indptr[f]: s_csc.indptr[f + 1]]
@@ -326,6 +337,7 @@ class BinnedDataset:
                         min_data_in_bin=config.min_data_in_bin,
                         use_missing=config.use_missing,
                         zero_as_missing=config.zero_as_missing,
+                        forced_bounds=forced_map.get(f),
                     )
                 )
             used = np.array(

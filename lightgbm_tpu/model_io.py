@@ -193,12 +193,28 @@ def _node_to_dict(t: Tree, index: int) -> Dict[str, Any]:
         d["right_child"] = _node_to_dict(t, int(t.right_child[index]))
         return d
     leaf = ~index
-    return {
+    d = {
         "leaf_index": leaf,
         "leaf_value": float(t.leaf_value[leaf]),
         "leaf_weight": float(t.leaf_weight[leaf]) if leaf < len(t.leaf_weight) else 0.0,
         "leaf_count": int(t.leaf_count[leaf]) if leaf < len(t.leaf_count) else 0,
     }
+    if t.is_linear:
+        # linear-leaf model terms (extension: the reference ToJSON emits
+        # none, so its dumps cannot round-trip linear trees; ours can —
+        # keys only appear on linear models, non-linear dumps unchanged)
+        d["leaf_const"] = (
+            float(t.leaf_const[leaf]) if leaf < len(t.leaf_const) else 0.0
+        )
+        d["leaf_features"] = (
+            [int(f) for f in t.leaf_features[leaf]]
+            if leaf < len(t.leaf_features) else []
+        )
+        d["leaf_coeff"] = (
+            [float(c) for c in t.leaf_coeff[leaf]]
+            if leaf < len(t.leaf_coeff) else []
+        )
+    return d
 
 
 def tree_to_dict(t: Tree, tree_index: int) -> Dict[str, Any]:
@@ -209,11 +225,17 @@ def tree_to_dict(t: Tree, tree_index: int) -> Dict[str, Any]:
         "num_cat": t.num_cat,
         "shrinkage": t.shrinkage,
     }
+    if t.is_linear:
+        d["is_linear"] = True
     if t.num_leaves == 1:
         d["tree_structure"] = {
             "leaf_value": float(t.leaf_value[0]),
             "leaf_count": int(t.leaf_count[0]) if len(t.leaf_count) else 0,
         }
+        if t.is_linear and len(t.leaf_const):
+            d["tree_structure"]["leaf_const"] = float(t.leaf_const[0])
+            d["tree_structure"]["leaf_features"] = []
+            d["tree_structure"]["leaf_coeff"] = []
     else:
         d["tree_structure"] = _node_to_dict(t, 0)
     return d
@@ -402,6 +424,145 @@ def load_model_string(model_str: str) -> Tuple[Config, GBDT]:
     if cur is not None:
         trees.append(parse_tree_block(cur))
     gbdt.models = trees
+    return cfg, gbdt
+
+
+# ---------------------------------------------------------------------------
+# JSON model loading: the inverse of dump_model_dict, so a Booster
+# round-trips through its dump_model() JSON (the registry's second
+# interop surface next to the text format; the reference only WRITES
+# JSON — DumpModel has no C++ loader — so this is a deliberate
+# extension for the serving registry).
+
+_MISSING_TYPE_BITS = {"None": 0, "Zero": 1, "NaN": 2}
+
+
+def tree_from_dict(d: Dict[str, Any]) -> Tree:
+    """Nested tree_structure dict (tree_to_dict output) -> Tree."""
+    n = int(d["num_leaves"])
+    t = Tree(num_leaves=n, shrinkage=float(d.get("shrinkage", 1.0)))
+    t.is_linear = bool(d.get("is_linear", False))
+    root = d.get("tree_structure", {})
+    if t.is_linear:
+        t.leaf_const = np.zeros(n, np.float64)
+        t.leaf_features = [[] for _ in range(n)]
+        t.leaf_coeff = [[] for _ in range(n)]
+    if n <= 1:
+        t.leaf_value = np.asarray([float(root.get("leaf_value", 0.0))])
+        t.leaf_count = np.asarray([int(root.get("leaf_count", 0))], np.int64)
+        t.leaf_weight = np.zeros(1, np.float64)
+        if t.is_linear:
+            t.leaf_const[0] = float(
+                root.get("leaf_const", root.get("leaf_value", 0.0))
+            )
+        return t
+    m = n - 1
+    t.split_feature = np.zeros(m, np.int32)
+    t.split_gain = np.zeros(m, np.float64)
+    t.threshold = np.zeros(m, np.float64)
+    t.decision_type = np.zeros(m, np.int32)
+    t.left_child = np.zeros(m, np.int32)
+    t.right_child = np.zeros(m, np.int32)
+    t.internal_value = np.zeros(m, np.float64)
+    t.internal_weight = np.zeros(m, np.float64)
+    t.internal_count = np.zeros(m, np.int64)
+    t.leaf_value = np.zeros(n, np.float64)
+    t.leaf_weight = np.zeros(n, np.float64)
+    t.leaf_count = np.zeros(n, np.int64)
+    cat_boundaries = [0]
+    cat_threshold: List[int] = []
+    n_cat = 0
+
+    def child_ix(node: Dict[str, Any]) -> int:
+        if "split_index" in node:
+            return int(node["split_index"])
+        return ~int(node.get("leaf_index", 0))
+
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if "split_index" not in node:  # leaf
+            li = int(node.get("leaf_index", 0))
+            t.leaf_value[li] = float(node.get("leaf_value", 0.0))
+            t.leaf_weight[li] = float(node.get("leaf_weight", 0.0))
+            t.leaf_count[li] = int(node.get("leaf_count", 0))
+            if t.is_linear:
+                t.leaf_const[li] = float(
+                    node.get("leaf_const", node.get("leaf_value", 0.0))
+                )
+                t.leaf_features[li] = [
+                    int(f) for f in node.get("leaf_features", [])
+                ]
+                t.leaf_coeff[li] = [
+                    float(c) for c in node.get("leaf_coeff", [])
+                ]
+            continue
+        i = int(node["split_index"])
+        t.split_feature[i] = int(node["split_feature"])
+        t.split_gain[i] = float(node.get("split_gain", 0.0))
+        dt = 0
+        if node.get("decision_type") == "==":  # categorical bitset
+            dt |= 1
+            cats = [int(c) for c in str(node["threshold"]).split("||") if c]
+            n_words = (max(cats) // 32 + 1) if cats else 1
+            words = [0] * n_words
+            for cv in cats:
+                words[cv // 32] |= 1 << (cv % 32)
+            t.threshold[i] = float(n_cat)
+            cat_threshold.extend(words)
+            cat_boundaries.append(len(cat_threshold))
+            n_cat += 1
+        else:
+            t.threshold[i] = float(node["threshold"])
+        if node.get("default_left"):
+            dt |= 2
+        dt |= _MISSING_TYPE_BITS.get(str(node.get("missing_type")), 0) << 2
+        t.decision_type[i] = dt
+        t.internal_value[i] = float(node.get("internal_value", 0.0))
+        t.internal_weight[i] = float(node.get("internal_weight", 0.0))
+        t.internal_count[i] = int(node.get("internal_count", 0))
+        left, right = node["left_child"], node["right_child"]
+        t.left_child[i] = child_ix(left)
+        t.right_child[i] = child_ix(right)
+        stack.append(right)
+        stack.append(left)
+    t.num_cat = n_cat
+    t.cat_boundaries = np.asarray(cat_boundaries, np.int64)
+    t.cat_threshold = np.asarray(cat_threshold, np.uint32)
+    return t
+
+
+def load_model_dict(d: Dict[str, Any]) -> Tuple[Config, GBDT]:
+    """dump_model_dict output -> prediction-capable (Config, GBDT)."""
+    params: Dict[str, Any] = {}
+    obj = _parse_objective(str(d.get("objective", "regression")))
+    params["objective"] = obj["objective"]
+    for src, dst, typ in (("num_class", "num_class", int),
+                          ("sigmoid", "sigmoid", float),
+                          ("alpha", "alpha", float),
+                          ("c", "fair_c", float),
+                          ("tweedie_variance_power",
+                           "tweedie_variance_power", float)):
+        if src in obj:
+            params[dst] = typ(obj[src])
+    cfg = Config(params)
+    gbdt = GBDT(cfg, None)
+    gbdt.num_class = int(d.get("num_tree_per_iteration", 1))
+    gbdt.average_output = bool(d.get("average_output", False))
+    gbdt.feature_names = list(d.get("feature_names", []))
+    infos = []
+    for name in gbdt.feature_names:
+        fi = (d.get("feature_infos") or {}).get(name)
+        if not fi:
+            infos.append("none")
+        elif fi.get("values"):
+            infos.append(":".join(str(int(v)) for v in fi["values"]))
+        elif fi.get("min_value") or fi.get("max_value"):
+            infos.append(f"[{fi['min_value']:g}:{fi['max_value']:g}]")
+        else:
+            infos.append("none")
+    gbdt.feature_infos_ = infos
+    gbdt.models = [tree_from_dict(td) for td in d.get("tree_info", [])]
     return cfg, gbdt
 
 

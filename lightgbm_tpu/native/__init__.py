@@ -185,7 +185,16 @@ def values_to_bins(values: np.ndarray, bounds: np.ndarray, nan_target: int
 
 class PackedModel:
     """Flat tree arrays for fp_predict, built once per Booster model
-    state (reference SingleRowPredictor caching, c_api.cpp:66)."""
+    state (reference SingleRowPredictor caching, c_api.cpp:66).
+
+    This offset-flat layout (per-tree node/leaf offsets into shared 1-D
+    arrays) is the host/C++ walker's shape; the TPU serving predictor
+    packs the same per-tree fields into DENSE (T, max_nodes) tables
+    instead (serving/forest.py pack_forest_tables), because lockstep
+    device traversal wants every lane indexing one rectangular table.
+    Decision semantics must stay identical across all three predictors
+    (tree.py go_left is the single source of truth; the serving parity
+    tests assert it)."""
 
     def __init__(self, trees) -> None:
         n_nodes = [max(t.num_leaves - 1, 0) for t in trees]

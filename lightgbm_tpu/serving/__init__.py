@@ -1,0 +1,37 @@
+"""TPU-resident inference & serving subsystem.
+
+The reference ships a dedicated fast-prediction layer
+(src/boosting/gbdt_prediction.cpp, the CUDA predictor) because
+inference is its own workload with its own shapes and latency budget;
+this package is the TPU analog. Three pieces:
+
+- ``forest``: a **tensorized predictor** — the trained forest compiled
+  into dense ``(trees, nodes)`` device tables and traversed for all
+  rows x trees with vectorized gathers under one ``jit`` (multi-chip
+  row sharding through the same ``shard_map`` seam training uses);
+- ``dispatch``: a **bucket-batched dispatcher** — incoming batches are
+  padded to a small fixed ladder of shapes so the number of XLA
+  compiles is bounded by the ladder length (retrace-guard-asserted),
+  with warm-up precompilation and a thread-safe microbatch queue;
+- ``registry``: a **model registry** — load / hot-swap / version
+  multiple Boosters (text or JSON model format) behind one scoring
+  entry point, plus the ``ScoringServer`` loop ``cli.py`` exposes as
+  ``task=serve``.
+
+See docs/SERVING.md for the architecture.
+"""
+
+from .dispatch import DEFAULT_BUCKETS, BucketDispatcher, MicroBatcher
+from .forest import TensorForest
+from .registry import ModelRegistry
+from .server import ScoringServer, serve_http
+
+__all__ = [
+    "TensorForest",
+    "BucketDispatcher",
+    "MicroBatcher",
+    "DEFAULT_BUCKETS",
+    "ModelRegistry",
+    "ScoringServer",
+    "serve_http",
+]

@@ -1,0 +1,237 @@
+"""Bucket-batched scoring dispatcher + thread-safe microbatch queue.
+
+Serving traffic arrives in arbitrary batch sizes; a jit cache keyed on
+raw shapes would compile once per distinct size (the classic shape-
+churn retrace). The dispatcher pads every request up to a small fixed
+ladder of row counts, so the number of XLA compiles is bounded by the
+ladder length — a contract the retrace guard asserts in
+tests/test_serving.py across a 100-request mixed-size sequence
+(analysis/retrace.py). Oversized batches are chunked into max-bucket
+pieces, so no request shape ever escapes the ladder.
+
+``warmup()`` precompiles every bucket up front (scoring zeros), moving
+all compile latency out of the serving path — the analog of the
+reference's SingleRowPredictor being built once per model
+(c_api.cpp:66), but per shape instead of per row.
+
+``MicroBatcher`` is the queueing half: callers ``submit()`` rows from
+any thread and get a Future; a single worker drains the queue,
+coalesces pending requests into one padded device call, and fans the
+rows of the result back out. Under concurrent small-batch load this
+turns q tiny dispatches into one bucket-sized dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import log
+from ..config import DEFAULT_SERVE_BUCKETS as DEFAULT_BUCKETS
+from ..timer import latency_stats
+
+
+class BucketDispatcher:
+    """Pads requests to a fixed shape ladder and scores on device."""
+
+    def __init__(self, forest, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 name: str = "serve"):
+        if not buckets:
+            raise ValueError("need at least one bucket size")
+        n_dev = max(int(getattr(forest, "num_devices", 1)), 1)
+        # every rung must shard evenly over the mesh row axis
+        aligned = sorted({
+            ((max(int(b), 1) + n_dev - 1) // n_dev) * n_dev for b in buckets
+        })
+        if list(aligned) != sorted(int(b) for b in buckets):
+            log.warning(
+                f"serving buckets {sorted(int(b) for b in buckets)} "
+                f"realigned to {aligned} (mesh of {n_dev} devices needs "
+                "row counts divisible by the device count)"
+            )
+        self.buckets: Tuple[int, ...] = tuple(aligned)
+        self.forest = forest
+        self._stats = latency_stats(name)
+
+    # ------------------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest rung >= n, else the largest (caller chunks)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def warmup(self, num_features: Optional[int] = None) -> None:
+        """Precompile every rung (zeros through the real entry point).
+
+        num_features defaults to the forest's widest referenced feature
+        + 1 — pass the true dataset width when it is larger, otherwise
+        the serving path would compile again on the first real batch.
+        """
+        import jax.numpy as jnp
+
+        F = max(self.forest.max_feature + 1, 1) \
+            if num_features is None else int(num_features)
+        tw = np.ones(self.forest.num_trees, np.float32)
+        for b in self.buckets:
+            score, _leaf = self.forest.apply(
+                jnp.zeros((b, F), jnp.float32), tw
+            )
+            score.block_until_ready()
+
+    # ------------------------------------------------------------------
+    def _bucketed_chunks(self, X: np.ndarray, tw: np.ndarray):
+        """Yield (score (n,K), leaf (n,T)) per max-bucket chunk, each
+        scored at its padded ladder shape — EVERY device call in the
+        dispatcher goes through here, so no request shape escapes the
+        ladder (the bounded-compiles contract covers pred_leaf too)."""
+        import jax.numpy as jnp
+
+        N = X.shape[0]
+        top = self.buckets[-1]
+        pos = 0
+        while pos < N:
+            chunk = X[pos: pos + top]
+            rows = chunk.shape[0]
+            b = self.bucket_for(rows)
+            if rows < b:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((b - rows, X.shape[1]), np.float32)]
+                )
+            score, leaf = self.forest.apply(jnp.asarray(chunk), tw)
+            yield np.asarray(score)[:rows], np.asarray(leaf)[:rows]
+            pos += top
+
+    def _prep(self, X, start_iteration: int, num_iteration: int):
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        self.forest._check_width(X)
+        tw, start, end = self.forest._tree_weights(
+            start_iteration, num_iteration
+        )
+        return X, tw, start, end
+
+    def score_raw(self, X: np.ndarray, start_iteration: int = 0,
+                  num_iteration: int = -1) -> np.ndarray:
+        """(K, N) raw margins via bucket-padded device calls."""
+        X, tw, start, end = self._prep(X, start_iteration, num_iteration)
+        if X.shape[0] == 0:  # filtered-empty request, not an error
+            return np.zeros((self.forest.num_class, 0), np.float64)
+        t0 = time.perf_counter()
+        outs = [s for s, _ in self._bucketed_chunks(X, tw)]
+        out = np.concatenate(outs).T.astype(np.float64)  # (K, N)
+        if self.forest.average_output and end > start:
+            out /= end - start
+        self._stats.observe(time.perf_counter() - t0, X.shape[0])
+        return out
+
+    def predict_leaf(self, X: np.ndarray, start_iteration: int = 0,
+                     num_iteration: int = -1) -> np.ndarray:
+        """(N, used_trees) leaf indices through the same bucket ladder
+        (a raw-shape forest.apply here would reintroduce the per-shape
+        compile churn the ladder exists to bound)."""
+        X, tw, start, end = self._prep(X, start_iteration, num_iteration)
+        K = self.forest.num_class
+        if X.shape[0] == 0:
+            return np.zeros((0, (end - start) * K), np.int64)
+        t0 = time.perf_counter()
+        leaves = [lf for _, lf in self._bucketed_chunks(X, tw)]
+        out = np.concatenate(leaves)[:, start * K: end * K]
+        self._stats.observe(time.perf_counter() - t0, X.shape[0])
+        return out.astype(np.int64)
+
+    def stats(self) -> dict:
+        return self._stats.snapshot()
+
+
+class MicroBatcher:
+    """Thread-safe request queue in front of a BucketDispatcher.
+
+    submit(rows) -> Future resolving to that request's (n, K) scores.
+    One worker thread drains the queue: everything pending (up to the
+    largest bucket) coalesces into a single padded device call.
+    """
+
+    def __init__(self, dispatcher: BucketDispatcher,
+                 max_delay_s: float = 0.002):
+        self.dispatcher = dispatcher
+        self.max_delay_s = float(max_delay_s)
+        self._pending: List[Tuple[np.ndarray, Future]] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="lgb-serve-microbatch", daemon=True
+        )
+        self._worker.start()
+
+    def submit(self, X: np.ndarray) -> Future:
+        """Queue rows for coalesced default-parameter scoring; resolves
+        to that request's (n, K) RAW margins. Non-default scoring
+        options (truncation, pred_leaf) go through the dispatcher
+        directly — requests in one coalesced batch must share one
+        parameter set."""
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        # validate in the submitter's thread: a malformed request must
+        # fail ITS caller, never the innocent requests it would have
+        # been coalesced with
+        self.dispatcher.forest._check_width(X)
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._pending.append((X, fut))
+            self._cond.notify()
+        return fut
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._worker.join(timeout=5)
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        top = self.dispatcher.buckets[-1]
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._pending:
+                    return
+                # brief linger so near-simultaneous submitters coalesce
+                if (len(self._pending) == 1
+                        and self._pending[0][0].shape[0] < top
+                        and not self._closed):
+                    self._cond.wait(self.max_delay_s)
+                batch: List[Tuple[np.ndarray, Future]] = []
+                rows = 0
+                # coalesce only same-width requests (widths >= the
+                # model's widest feature are all valid, so a mixed
+                # queue would break np.concatenate); stragglers stay
+                # pending for the next drain
+                width = self._pending[0][0].shape[1]
+                while (self._pending and rows < top
+                       and self._pending[0][0].shape[1] == width):
+                    X, fut = self._pending.pop(0)
+                    batch.append((X, fut))
+                    rows += X.shape[0]
+            try:
+                Xall = np.concatenate([x for x, _ in batch]) \
+                    if len(batch) > 1 else batch[0][0]
+                out = self.dispatcher.score_raw(Xall)  # (K, N)
+                pos = 0
+                for X, fut in batch:
+                    n = X.shape[0]
+                    fut.set_result(out[:, pos: pos + n].T)  # (n, K)
+                    pos += n
+            except Exception as e:  # noqa: BLE001 — fan the error out
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)

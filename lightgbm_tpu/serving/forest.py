@@ -1,0 +1,422 @@
+"""Tensorized forest predictor: the trained model as device tables.
+
+The host predictors (tree.py vectorized walk, native/ fp_predict) walk
+pointer-shaped trees row by row; on TPU that shape is hostile — the
+win comes from giving every (row, tree) lane the same dense program.
+This module lifts the flat per-tree arrays (the same layout
+``native.PackedModel`` packs for the C++ walker: feature index,
+threshold, decision type, children, leaf values, categorical bitsets,
+linear-leaf coefficients) into rectangular ``(T, max_nodes)`` /
+``(T, max_leaves)`` tables and traverses **all rows x all trees in
+lockstep** under one ``jit``:
+
+- per level, every lane's node parameters come from ONE packed-table
+  gather (``take_cols`` — the MXU one-hot contraction training's
+  validation traversal already uses, histogram.py:380);
+- each lane's split-feature value is a ``take_along_axis`` row gather;
+- the loop is a ``lax.while_loop`` bounded by the forest's max depth
+  (every lane advances one level per pass, like traverse_tree_bins);
+- per-class accumulation is a single ``(N, T) @ (T, K)`` one-hot
+  matmul, with a ``(T,)`` weight vector implementing
+  ``start_iteration`` / ``num_iteration`` truncation WITHOUT a
+  retrace (the weights are an argument, not a static).
+
+Decision semantics mirror ``tree.py`` ``Tree.go_left`` bit for bit
+(missing types None/Zero/NaN, default direction, categorical bitsets,
+linear-leaf NaN fallback); the parity tests in
+tests/test_serving.py assert agreement with the native walker across
+model families. Tables ride the jit boundary as ARGUMENTS, so two
+models with the same (T, M, L) shapes share one executable — hot-swap
+in the registry does not recompile.
+
+All tables are f32/int32: the scoring jaxpr carries the same
+no-f64 / no-host-callback contracts as the training entry points
+(analysis/jaxpr_audit.py ``serving_forest`` entry).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+# reference include/LightGBM/bin.h kZeroThreshold (tree.h Decision) —
+# the zero-as-missing band, shared with the host walk via binning
+from ..binning import K_ZERO_THRESHOLD as _K_ZERO
+
+
+def pack_forest_tables(models, num_class: int) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Host packing: list of Tree -> rectangular numpy tables + static
+    metadata. The numpy side of the split so the jit side is pure
+    device math (and so the audit can trace it from shapes alone)."""
+    T = len(models)
+    K = max(int(num_class), 1)
+    n_nodes = [max(t.num_leaves - 1, 0) for t in models]
+    M = max(n_nodes + [1])
+    L = max([t.num_leaves for t in models] + [1])
+    depth = max([t.max_depth() for t in models] + [1])
+
+    feature = np.zeros((T, M), np.int32)
+    threshold = np.zeros((T, M), np.float32)
+    miss_type = np.zeros((T, M), np.int32)
+    default_left = np.zeros((T, M), bool)
+    is_cat = np.zeros((T, M), bool)
+    # padding nodes route straight to leaf 0 so a runaway lane terminates
+    left = np.full((T, M), -1, np.int32)
+    right = np.full((T, M), -1, np.int32)
+    leaf_value = np.zeros((T, L), np.float32)
+    cat_lo = np.zeros((T, M), np.int32)
+    cat_nw = np.zeros((T, M), np.int32)
+    catw_parts: List[np.ndarray] = []
+    wbase = 0
+    any_cat = False
+    any_linear = any(t.is_linear for t in models)
+    Ck = 1
+    if any_linear:
+        Ck = max(
+            (len(f) for t in models if t.is_linear for f in t.leaf_features),
+            default=1,
+        ) or 1
+    leaf_const = np.zeros((T, L), np.float32)
+    leaf_nf = np.zeros((T, L), np.int32)
+    leaf_feat = np.zeros((T, L, Ck), np.int32)
+    leaf_coeff = np.zeros((T, L, Ck), np.float32)
+    init_node = np.zeros(T, np.int32)
+    max_feature = -1
+
+    for ti, t in enumerate(models):
+        n = n_nodes[ti]
+        if n == 0:
+            init_node[ti] = -1  # stump: lane starts AT leaf 0 (~0 == -1)
+        else:
+            feature[ti, :n] = t.split_feature[:n]
+            # directed f64->f32 cast: never round a threshold UP across
+            # its f64 value, or an exactly-f32 feature value in
+            # (thr, f32(thr)] would flip from right to left vs the f64
+            # host walker — a whole-leaf divergence, not 1e-5 noise
+            thr64 = np.asarray(t.threshold[:n], np.float64)
+            t32 = thr64.astype(np.float32)
+            up = t32.astype(np.float64) > thr64
+            t32[up] = np.nextafter(t32[up], np.float32(-np.inf))
+            threshold[ti, :n] = t32
+            dt = np.asarray(t.decision_type[:n], np.int64)
+            miss_type[ti, :n] = (dt >> 2) & 3
+            default_left[ti, :n] = (dt & 2) != 0
+            is_cat[ti, :n] = (dt & 1) != 0
+            left[ti, :n] = t.left_child[:n]
+            right[ti, :n] = t.right_child[:n]
+            max_feature = max(max_feature, int(np.max(t.split_feature[:n])))
+            cat_k = np.flatnonzero(is_cat[ti, :n])
+            if len(cat_k):
+                any_cat = True
+                cb = np.asarray(t.cat_boundaries, np.int64)
+                words = np.asarray(t.cat_threshold, np.uint32)
+                catw_parts.append(words)
+                ci = np.asarray(t.threshold, np.float64)[cat_k].astype(np.int64)
+                cat_lo[ti, cat_k] = wbase + cb[ci]
+                cat_nw[ti, cat_k] = cb[ci + 1] - cb[ci]
+                wbase += len(words)
+        lv = np.asarray(t.leaf_value, np.float32)
+        leaf_value[ti, : len(lv)] = lv
+        leaf_const[ti, : len(lv)] = lv  # non-linear: lin path == leaf_value
+        if t.is_linear:
+            lc = np.asarray(t.leaf_const, np.float32)
+            leaf_const[ti, : len(lc)] = lc
+            for li, feats in enumerate(t.leaf_features):
+                k = len(feats)
+                leaf_nf[ti, li] = k
+                if k:
+                    leaf_feat[ti, li, :k] = feats
+                    leaf_coeff[ti, li, :k] = np.asarray(
+                        t.leaf_coeff[li], np.float32
+                    )
+                    max_feature = max(max_feature, max(feats))
+
+    catw = (
+        np.concatenate(catw_parts).astype(np.uint32)
+        if catw_parts else np.zeros(1, np.uint32)
+    )
+    # per-node packed parameter table for the single take_cols gather:
+    # every field is exact in f32 (ints < 2^24, thresholds already f32)
+    pack = np.stack([
+        feature.reshape(-1).astype(np.float32),       # 0
+        threshold.reshape(-1),                        # 1
+        miss_type.reshape(-1).astype(np.float32),     # 2
+        default_left.reshape(-1).astype(np.float32),  # 3
+        is_cat.reshape(-1).astype(np.float32),        # 4
+        left.reshape(-1).astype(np.float32),          # 5
+        right.reshape(-1).astype(np.float32),         # 6
+        cat_lo.reshape(-1).astype(np.float32),        # 7
+        cat_nw.reshape(-1).astype(np.float32),        # 8
+    ])
+    class_onehot = np.zeros((T, K), np.float32)
+    class_onehot[np.arange(T), np.arange(T) % K] = 1.0
+
+    tables = {
+        "pack": pack,                         # (9, T*M) f32
+        "catw": catw.view(np.int32),          # (W,) int32 bit-patterns
+        "leaf_value": leaf_value,             # (T, L) f32
+        "leaf_const": leaf_const,             # (T, L) f32
+        "leaf_nf": leaf_nf,                   # (T, L) int32
+        "leaf_feat": leaf_feat,               # (T, L, Ck) int32
+        "leaf_coeff": leaf_coeff,             # (T, L, Ck) f32
+        "init_node": init_node,               # (T,) int32
+        "class_onehot": class_onehot,         # (T, K) f32
+    }
+    meta = {
+        "num_trees": T, "num_class": K, "max_nodes": M, "max_leaves": L,
+        "max_depth": int(depth), "has_cat": bool(any_cat),
+        "linear": bool(any_linear), "max_feature": int(max_feature),
+    }
+    return tables, meta
+
+
+def forest_apply(tables, X, tree_w, *, has_cat: bool = True,
+                 linear: bool = False, max_depth: int = 0):
+    """Device traversal: (N, F) rows x all T trees -> per-class raw
+    scores (N, K) and per-tree leaf indices (N, T).
+
+    `tables` is the pack_forest_tables pytree (jnp arrays); `tree_w`
+    is the (T,) f32 per-tree weight implementing iteration truncation.
+    Pure jax — jit/shard_map wrapping happens in TensorForest.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..learner.histogram import take_cols
+
+    T, L = tables["leaf_value"].shape
+    M = tables["pack"].shape[1] // T
+    N = X.shape[0]
+    tpos = (jnp.arange(T, dtype=jnp.int32) * M)[None, :]  # (1, T)
+    cur0 = jnp.broadcast_to(tables["init_node"][None, :], (N, T))
+    # every lane descends one edge per pass, so the forest's max depth
+    # (pack_forest_tables meta) bounds the loop tighter than the node
+    # count; <=0 falls back to M
+    bound = M if max_depth <= 0 else min(int(max_depth), M)
+
+    def cond(s):
+        it, cur = s
+        return (it < bound) & jnp.any(cur >= 0)
+
+    def body(s):
+        it, cur = s
+        node = jnp.maximum(cur, 0)  # leaf lanes compute a dead decision
+        flat = (tpos + node).reshape(-1)  # (N*T,)
+        v = take_cols(tables["pack"], flat)  # (9, N*T)
+        v = v.reshape(9, N, T)
+        f = v[0].astype(jnp.int32)
+        thr = v[1]
+        mt = v[2].astype(jnp.int32)
+        dl = v[3] > 0.5
+        x = jnp.take_along_axis(X, f, axis=1)  # (N, T)
+        isna = jnp.isnan(x)
+        # missing != NaN: NaN behaves as 0.0 (tree.h Decision)
+        xv = jnp.where(isna & (mt != 2), 0.0, x)
+        miss = jnp.where(
+            mt == 2, isna, (mt == 1) & (jnp.abs(xv) <= _K_ZERO)
+        )
+        go_left = jnp.where(miss, dl, xv <= thr)
+        if has_cat:
+            nw = v[8].astype(jnp.int32)
+            iv = jnp.nan_to_num(x, nan=-1.0, posinf=-1.0, neginf=-1.0)
+            iv = iv.astype(jnp.int32)
+            ok = (~isna) & (iv >= 0) & (iv < 32 * nw)
+            widx = v[7].astype(jnp.int32) + jnp.maximum(iv, 0) // 32
+            W = tables["catw"].shape[0]
+            w = tables["catw"][jnp.clip(widx, 0, W - 1)]
+            bit = lax.shift_right_logical(w, jnp.maximum(iv, 0) % 32) & 1
+            go_left = jnp.where(v[4] > 0.5, ok & (bit == 1), go_left)
+        child = jnp.where(go_left, v[5], v[6]).astype(jnp.int32)
+        cur = jnp.where(cur >= 0, child, cur)
+        return it + 1, cur
+
+    _, cur = lax.while_loop(cond, body, (jnp.int32(0), cur0))
+    leaf = jnp.where(cur < 0, ~cur, 0)  # (N, T)
+    lflat = (jnp.arange(T, dtype=jnp.int32) * L)[None, :] + leaf
+    val = tables["leaf_value"].reshape(-1)[lflat]  # (N, T)
+    if linear:
+        Ck = tables["leaf_feat"].shape[2]
+        const = tables["leaf_const"].reshape(-1)[lflat]
+        nf = tables["leaf_nf"].reshape(-1)[lflat]
+        fidx = tables["leaf_feat"].reshape(-1, Ck)[lflat]    # (N, T, Ck)
+        co = tables["leaf_coeff"].reshape(-1, Ck)[lflat]
+        xg = X[jnp.arange(N, dtype=jnp.int32)[:, None, None], fidx]
+        kmask = jnp.arange(Ck, dtype=jnp.int32)[None, None, :] < nf[..., None]
+        contrib = jnp.sum(jnp.where(kmask, co * xg, 0.0), axis=-1)
+        anynan = jnp.any(kmask & jnp.isnan(xg), axis=-1)
+        # linear semantics (tree.cpp:137-153): const + coeffs . x,
+        # rows with NaN in a used feature fall back to leaf_value
+        val = jnp.where(anynan, val, const + contrib)
+    score = (val * tree_w[None, :]) @ tables["class_onehot"]  # (N, K)
+    return score, leaf
+
+
+_APPLY_JIT = None
+
+
+def _forest_apply_jit():
+    """Shared module-level jit of forest_apply (lazy so importing the
+    package never initializes a backend): every non-mesh TensorForest
+    scores through this ONE callable, so same-shaped tables — model
+    hot-swaps, registry versions — reuse one executable per bucket."""
+    global _APPLY_JIT
+    if _APPLY_JIT is None:
+        import jax
+
+        _APPLY_JIT = jax.jit(
+            forest_apply, static_argnames=("has_cat", "linear", "max_depth")
+        )
+    return _APPLY_JIT
+
+
+class TensorForest:
+    """A trained forest compiled to device tables + a scoring callable.
+
+    ``mesh=None`` (or a 1-device mesh) uses the shared module-level jit
+    — model hot-swaps with identical table shapes reuse the executable.
+    With a multi-device mesh the row axis is sharded over
+    ``axis_name`` through the same ``shard_map_compat`` seam training
+    uses (tables replicated); callers must pad rows to a multiple of
+    the mesh size (``BucketDispatcher`` aligns its ladder for this).
+    """
+
+    def __init__(self, models, num_class: int = 1,
+                 average_output: bool = False, mesh=None,
+                 axis_name: str = "data"):
+        import jax
+        import jax.numpy as jnp
+
+        if not models:
+            raise ValueError("TensorForest needs at least one tree")
+        tables, meta = pack_forest_tables(models, num_class)
+        self.meta = meta
+        # while_loop bound: true max depth rounded UP to a power of two
+        # — max_depth is a static jit arg, so quantizing keeps the
+        # hot-swap executable-reuse property for same-shaped models
+        # with nearby depths (any bound >= true depth is correct)
+        d = max(int(meta["max_depth"]), 1)
+        self._depth_bound = 1 << (d - 1).bit_length()
+        self.num_class = meta["num_class"]
+        self.num_trees = meta["num_trees"]
+        self.average_output = bool(average_output)
+        self.max_feature = meta["max_feature"]
+        self.mesh = None
+        self.axis_name = axis_name
+        n_dev = 1
+        if mesh is not None and int(np.prod(mesh.devices.shape)) > 1:
+            self.mesh = mesh
+            n_dev = int(np.prod(mesh.devices.shape))
+        self.num_devices = n_dev
+        if self.mesh is None:
+            self.tables = {k: jnp.asarray(v) for k, v in tables.items()}
+            self._fn = _forest_apply_jit()
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.data_parallel import shard_map_compat
+
+            rep = NamedSharding(self.mesh, P())
+            self.tables = {
+                k: jax.device_put(jnp.asarray(v), rep)
+                for k, v in tables.items()
+            }
+            has_cat, linear = meta["has_cat"], meta["linear"]
+            max_depth = self._depth_bound
+
+            def fn(tables, X, tree_w):
+                return forest_apply(tables, X, tree_w,
+                                    has_cat=has_cat, linear=linear,
+                                    max_depth=max_depth)
+
+            tspec = jax.tree.map(lambda _: P(), self.tables)
+            self._sharded = jax.jit(shard_map_compat(
+                fn, mesh=self.mesh,
+                in_specs=(tspec, P(axis_name, None), P()),
+                out_specs=(P(axis_name, None), P(axis_name, None)),
+                check_vma=False,
+            ))
+            self._fn = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_booster(cls, booster, mesh=None) -> "TensorForest":
+        g = booster._gbdt
+        return cls(
+            list(g.models), g.num_class,
+            average_output=bool(getattr(g, "average_output", False)),
+            mesh=mesh,
+        )
+
+    @property
+    def jit_entry(self):
+        """The jitted scoring callable — hand this to retrace_guard
+        entry_points to assert the compile-per-bucket contract."""
+        return self._sharded if self.mesh is not None else self._fn
+
+    def _tree_weights(self, start_iteration: int,
+                      num_iteration: int) -> Tuple[np.ndarray, int, int]:
+        K = self.num_class
+        n_iters = self.num_trees // K
+        end = n_iters if num_iteration <= 0 else min(
+            n_iters, start_iteration + num_iteration
+        )
+        tw = np.zeros(self.num_trees, np.float32)
+        tw[start_iteration * K: end * K] = 1.0
+        return tw, start_iteration, end
+
+    def _check_width(self, X: np.ndarray) -> None:
+        if X.shape[1] <= self.max_feature:
+            # keep the host walk's error semantics (tree.py predict_leaf
+            # raises IndexError on narrow input)
+            raise IndexError(
+                f"input has {X.shape[1]} features but the model "
+                f"references feature {self.max_feature}"
+            )
+
+    def apply(self, X, tree_w):
+        """Raw device call on an already-padded f32 row block."""
+        import jax.numpy as jnp
+
+        tw = jnp.asarray(tree_w, jnp.float32)
+        if self.mesh is not None:
+            return self._sharded(self.tables, X, tw)
+        return self._fn(
+            self.tables, X, tw,
+            has_cat=self.meta["has_cat"], linear=self.meta["linear"],
+            max_depth=self._depth_bound,
+        )
+
+    def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        """(K, N) raw margins, matching GBDT.predict_raw layout."""
+        import jax.numpy as jnp
+
+        X = np.asarray(X, np.float32)
+        self._check_width(X)
+        tw, start, end = self._tree_weights(start_iteration, num_iteration)
+        N = X.shape[0]
+        pad = (-N) % max(self.num_devices, 1)
+        if pad:
+            X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)])
+        score, _ = self.apply(jnp.asarray(X), tw)
+        out = np.asarray(score)[:N].T.astype(np.float64)  # (K, N)
+        if self.average_output and end > start:
+            out /= end - start
+        return out
+
+    def predict_leaf(self, X: np.ndarray, start_iteration: int = 0,
+                     num_iteration: int = -1) -> np.ndarray:
+        """(N, used_trees) leaf indices (Booster.predict pred_leaf)."""
+        import jax.numpy as jnp
+
+        X = np.asarray(X, np.float32)
+        self._check_width(X)
+        tw, start, end = self._tree_weights(start_iteration, num_iteration)
+        N = X.shape[0]
+        pad = (-N) % max(self.num_devices, 1)
+        if pad:
+            X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)])
+        _, leaf = self.apply(jnp.asarray(X), tw)
+        K = self.num_class
+        return np.asarray(leaf)[:N, start * K: end * K].astype(np.int64)

@@ -1,0 +1,245 @@
+"""Model registry: load / version / hot-swap Boosters behind one
+scoring entry point.
+
+The reference's serving story is one SingleRowPredictor per Booster
+handle (c_api.cpp:66); a real scoring service juggles many models and
+replaces them under traffic. The registry keeps, per model NAME, a
+monotonically versioned list of (Booster, TensorForest,
+BucketDispatcher) triples and an ACTIVE version pointer:
+
+- ``load`` accepts a text model file, a ``.json`` dump file, a raw
+  model string, a dump dict, or a live Booster (text / JSON via
+  model_io.py) and builds the device tables + bucket dispatcher;
+- ``swap`` / ``rollback`` move the active pointer atomically (a swap
+  is a pointer write under the registry lock — in-flight requests on
+  the old version finish on the old tables, which stay alive until
+  ``unload``);
+- ``predict`` scores on whatever version is active at call time.
+
+Because TensorForest scores through one shared jitted entry, a
+hot-swap to a model with the same (trees, nodes, leaves) table shapes
+and power-of-two depth bucket reuses the compiled executable — no
+recompile pause under traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import log
+from .dispatch import DEFAULT_BUCKETS, BucketDispatcher
+from .forest import TensorForest
+
+
+@dataclass
+class ModelVersion:
+    version: int
+    booster: Any
+    forest: TensorForest
+    dispatcher: BucketDispatcher
+    source: str
+    loaded_at: float = field(default_factory=time.time)
+    batcher: Any = None  # lazy MicroBatcher (predict via_queue=True)
+
+
+def _booster_from(source: Any):
+    """Anything model-shaped -> Booster (text or JSON via model_io)."""
+    from ..basic import Booster
+
+    if isinstance(source, Booster):
+        return source, "booster"
+    if isinstance(source, dict):
+        from ..model_io import load_model_dict
+
+        cfg, gbdt = load_model_dict(source)
+        b = Booster.__new__(Booster)
+        b.params, b.best_iteration, b.best_score = {}, -1, {}
+        b._train_data_name, b.pandas_categorical = "training", None
+        b.config, b._gbdt = cfg, gbdt
+        b.train_set, b._valid_sets, b._name_valid_sets = None, [], []
+        return b, "json-dict"
+    s = str(source)
+    # a model STRING always spans many lines; a path never does (so a
+    # file named tree_v2.txt is not misread as an inline model)
+    if s.lstrip().startswith("tree") and "\n" in s:
+        return Booster(model_str=s), "model-string"
+    if s.endswith(".json"):
+        import json
+        from pathlib import Path
+
+        return _booster_from(json.loads(Path(s).read_text()))[0], s
+    return Booster(model_file=s), s
+
+
+class ModelRegistry:
+    """Thread-safe named + versioned model store (docs/SERVING.md)."""
+
+    def __init__(self, mesh=None, buckets=DEFAULT_BUCKETS,
+                 warmup: bool = False):
+        self.mesh = mesh
+        self.buckets = tuple(int(b) for b in buckets)
+        self.default_warmup = bool(warmup)
+        self._lock = threading.RLock()
+        self._models: Dict[str, List[ModelVersion]] = {}
+        self._active: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def load(self, name: str, source: Any, *, activate: bool = True,
+             warmup: Optional[bool] = None,
+             num_features: Optional[int] = None) -> int:
+        """Build device tables for a model and register a new version.
+
+        Packing + (optional) warm-up happen OUTSIDE the lock: a load
+        must never stall scoring on already-active models."""
+        booster, src = _booster_from(source)
+        forest = TensorForest.from_booster(booster, mesh=self.mesh)
+        dispatcher = BucketDispatcher(
+            forest, self.buckets, name=f"serve:{name}"
+        )
+        do_warm = self.default_warmup if warmup is None else warmup
+        if do_warm:
+            if num_features is None:
+                # warm at the model's DECLARED width (protocol rows carry
+                # every column) — max_feature+1 would be too narrow, and
+                # each bucket would recompile on the first real batch
+                try:
+                    num_features = booster.num_feature() or None
+                except Exception:  # noqa: BLE001 — fall back to max_feature
+                    num_features = None
+            dispatcher.warmup(num_features)
+        with self._lock:
+            versions = self._models.setdefault(name, [])
+            v = (versions[-1].version + 1) if versions else 1
+            versions.append(ModelVersion(v, booster, forest, dispatcher, src))
+            if activate or name not in self._active:
+                self._active[name] = v
+        log.info(f"serving registry: loaded {name!r} v{v} from {src}")
+        return v
+
+    def _entry(self, name: str, version: Optional[int] = None) -> ModelVersion:
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(f"unknown model {name!r}")
+            v = self._active[name] if version is None else int(version)
+            for mv in self._models[name]:
+                if mv.version == v:
+                    return mv
+            raise KeyError(f"model {name!r} has no version {v}")
+
+    def swap(self, name: str, version: int) -> None:
+        """Atomically point `name` at an already-loaded version."""
+        with self._lock:
+            mv = self._entry(name, version)
+            self._active[name] = mv.version
+
+    def rollback(self, name: str) -> int:
+        """Activate the newest version BELOW the active one."""
+        with self._lock:
+            cur = self._active[name]
+            older = [mv.version for mv in self._models[name]
+                     if mv.version < cur]
+            if not older:
+                raise KeyError(f"model {name!r} has no version below {cur}")
+            self._active[name] = max(older)
+            return self._active[name]
+
+    def unload(self, name: str, version: Optional[int] = None) -> None:
+        """Drop one version (or the whole name); the active version of
+        a name can only be dropped by dropping the name. Dropped
+        versions' microbatch workers are closed, so unload really
+        releases the forest tables (a parked worker thread would pin
+        them)."""
+        dropped: List[ModelVersion] = []
+        with self._lock:
+            if version is None:
+                dropped = self._models.pop(name, [])
+                self._active.pop(name, None)
+            else:
+                if self._active.get(name) == int(version):
+                    raise ValueError(
+                        f"version {version} of {name!r} is active; swap "
+                        "first or unload the whole name"
+                    )
+                kept = []
+                for mv in self._models.get(name, []):
+                    (kept if mv.version != int(version)
+                     else dropped).append(mv)
+                self._models[name] = kept
+        for mv in dropped:  # outside the lock: close() joins the worker
+            if mv.batcher is not None:
+                mv.batcher.close()
+
+    def models(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                name: {
+                    "active": self._active.get(name),
+                    "versions": [
+                        {"version": mv.version, "source": mv.source,
+                         "num_trees": mv.forest.num_trees,
+                         "num_class": mv.forest.num_class,
+                         "loaded_at": mv.loaded_at}
+                        for mv in versions
+                    ],
+                }
+                for name, versions in self._models.items()
+            }
+
+    def stats(self) -> Dict[str, Any]:
+        # one pass under the lock (like models()): resolving entries
+        # after releasing it would let a concurrent unload turn the
+        # whole stats request into a KeyError
+        with self._lock:
+            return {
+                name: self._entry(name).dispatcher.stats()
+                for name in self._models
+            }
+
+    # ------------------------------------------------------------------
+    def predict(self, name: str, X, *, raw_score: bool = False,
+                start_iteration: int = 0, num_iteration: int = -1,
+                pred_leaf: bool = False, via_queue: bool = False,
+                version: Optional[int] = None) -> np.ndarray:
+        """One scoring entry point for every registered model; output
+        layout matches Booster.predict ((N,) single-class, (N, K)
+        multiclass, (N, T) for pred_leaf).
+
+        via_queue=True routes default-parameter scoring through the
+        version's MicroBatcher, so concurrent callers (the threaded
+        HTTP server's request threads, protocol "queue": true) coalesce
+        into shared padded device calls; truncated or pred_leaf
+        requests always dispatch directly (a coalesced batch must share
+        one parameter set)."""
+        mv = self._entry(name, version)
+        if pred_leaf:
+            return mv.dispatcher.predict_leaf(
+                X, start_iteration, num_iteration
+            )
+        batcher = None
+        if via_queue and start_iteration == 0 and num_iteration == -1:
+            with self._lock:
+                # re-check registration under the lock: a concurrent
+                # unload() must not have its version resurrected with a
+                # fresh worker thread nothing would ever close
+                registered = any(
+                    m is mv for m in self._models.get(name, [])
+                )
+                if registered:
+                    if mv.batcher is None:
+                        from .dispatch import MicroBatcher
+
+                        mv.batcher = MicroBatcher(mv.dispatcher)
+                    batcher = mv.batcher
+        if batcher is not None:
+            raw = batcher.submit(X).result().T  # (K, n)
+        else:
+            raw = mv.dispatcher.score_raw(X, start_iteration, num_iteration)
+        g = mv.booster._gbdt
+        if not raw_score and g.objective is not None:
+            raw = g.objective.convert_output(raw)
+        return raw[0] if mv.forest.num_class == 1 else raw.T

@@ -1,0 +1,172 @@
+"""Scoring server: JSONL request loop + optional HTTP front end.
+
+Two transports over ONE request vocabulary (docs/SERVING.md):
+
+- ``ScoringServer`` — line-delimited JSON over a pair of streams
+  (stdin/stdout under ``task=serve serve_port=0``). One request per
+  line, one response line per request. This is the testable core and
+  what tools/serve_smoke.sh drives end to end.
+- ``serve_http`` — a stdlib ThreadingHTTPServer mapping
+  ``POST /v1/<op>`` to the same handler (no new dependencies). Each
+  request runs on its own thread; score requests carrying
+  ``"queue": true`` additionally coalesce through the model's
+  MicroBatcher into shared padded device calls.
+
+Request ops:
+  {"op": "score", "model": "m", "rows": [[...], ...],
+   "raw_score": false, "num_iteration": -1, "pred_leaf": false}
+  {"op": "load", "model": "m", "path": "model.txt"}   # or "model_str"
+  {"op": "swap", "model": "m", "version": 2}
+  {"op": "rollback", "model": "m"}
+  {"op": "models"} / {"op": "stats"} / {"op": "ping"} / {"op": "quit"}
+
+Responses: {"ok": true, ...} or {"ok": false, "error": "..."}; scores
+ride as nested lists, latency from timer.latency_stats rides in
+"stats".
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, IO, Optional
+
+import numpy as np
+
+from .. import log
+from .registry import ModelRegistry
+
+
+def handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, Any]:
+    """One request dict -> one response dict (shared by both transports)."""
+    op = req.get("op", "score")
+    try:
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        if op == "models":
+            return {"ok": True, "models": registry.models()}
+        if op == "stats":
+            return {"ok": True, "stats": registry.stats()}
+        if op == "load":
+            src = req.get("model_str") or req.get("path")
+            if not src:
+                raise ValueError("load needs 'path' or 'model_str'")
+            v = registry.load(
+                req.get("model", "default"), src,
+                warmup=req.get("warmup"),
+                num_features=req.get("num_features"),
+            )
+            return {"ok": True, "version": v}
+        if op == "swap":
+            registry.swap(req["model"], int(req["version"]))
+            return {"ok": True, "active": int(req["version"])}
+        if op == "rollback":
+            v = registry.rollback(req["model"])
+            return {"ok": True, "active": v}
+        if op == "score":
+            rows = np.asarray(req["rows"], np.float32)
+            pred = registry.predict(
+                req.get("model", "default"), rows,
+                raw_score=bool(req.get("raw_score", False)),
+                start_iteration=int(req.get("start_iteration", 0)),
+                num_iteration=int(req.get("num_iteration", -1)),
+                pred_leaf=bool(req.get("pred_leaf", False)),
+                via_queue=bool(req.get("queue", False)),
+                version=req.get("version"),
+            )
+            return {"ok": True, "pred": np.asarray(pred).tolist()}
+        if op == "quit":
+            return {"ok": True, "quit": True}
+        raise ValueError(f"unknown op {op!r}")
+    except Exception as e:  # noqa: BLE001 — a bad request must not kill serving
+        return {"ok": False, "op": op, "error": f"{type(e).__name__}: {e}"}
+
+
+class ScoringServer:
+    """JSONL loop over (in_stream, out_stream)."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None):
+        self.registry = registry if registry is not None else ModelRegistry()
+
+    def serve(self, in_stream: IO[str], out_stream: IO[str]) -> int:
+        """Read one JSON request per line until EOF or op=quit; returns
+        the number of requests handled."""
+        handled = 0
+        for line in in_stream:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError as e:
+                resp: Dict[str, Any] = {
+                    "ok": False, "error": f"bad json: {e}"
+                }
+            else:
+                resp = handle_request(self.registry, req)
+            out_stream.write(json.dumps(resp) + "\n")
+            out_stream.flush()
+            handled += 1
+            if resp.get("quit"):
+                break
+        return handled
+
+
+def serve_http(registry: ModelRegistry, port: int,
+               host: str = "127.0.0.1", block: bool = True):
+    """HTTP server: POST /v1/<op> with the same JSON bodies ("op"
+    inferred from the path); GET /v1/models, /v1/stats, /healthz.
+    port=0 binds an ephemeral port. With block=True (the task=serve
+    mode) returns only when the process is interrupted; block=False
+    returns the bound httpd immediately (serve it from your own
+    thread; tests do this) — call .shutdown() to stop."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, resp: Dict[str, Any], code: int = 200) -> None:
+            body = json.dumps(resp).encode()
+            if code == 200 and not resp.get("ok", True):
+                code = 400  # handler errors; explicit codes (404) win
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path in ("/healthz", "/health"):
+                self._reply({"ok": True})
+            elif self.path == "/v1/models":
+                self._reply(handle_request(registry, {"op": "models"}))
+            elif self.path == "/v1/stats":
+                self._reply(handle_request(registry, {"op": "stats"}))
+            else:
+                self._reply({"ok": False, "error": "not found"}, 404)
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+            except json.JSONDecodeError as e:
+                self._reply({"ok": False, "error": f"bad json: {e}"}, 400)
+                return
+            if self.path.startswith("/v1/"):
+                req.setdefault("op", self.path[len("/v1/"):])
+            if req.get("op") == "quit":  # no remote shutdown over HTTP
+                self._reply({"ok": False, "error": "quit is stdio-only"}, 400)
+                return
+            self._reply(handle_request(registry, req))
+
+        def log_message(self, fmt, *args):  # route through package log
+            log.debug(f"serve http: {fmt % args}")
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    log.info(f"serving on http://{host}:{httpd.server_address[1]}/v1")
+    if not block:
+        return httpd
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return httpd

@@ -21,7 +21,7 @@ from typing import TYPE_CHECKING, List, Optional, Sequence
 
 import numpy as np
 
-from .binning import BinType, MissingType
+from .binning import BinType, K_ZERO_THRESHOLD, MissingType
 
 if TYPE_CHECKING:
     from .dataset import BinnedDataset
@@ -189,7 +189,7 @@ class Tree:
         else:
             if isna:
                 v = 0.0
-            if missing_type == 1 and abs(v) <= 1e-35:  # Zero as missing
+            if missing_type == 1 and abs(v) <= K_ZERO_THRESHOLD:  # Zero as missing
                 return default_left
         return bool(v <= self.threshold[node])
 
@@ -217,7 +217,7 @@ class Tree:
                 dl = (dt[num_idx] & _DEFAULT_LEFT_MASK) != 0
                 isna = np.isnan(xv)
                 # Zero missing: NaN and 0 treated as missing (tree.cpp Decision)
-                miss = np.where(mt == 2, isna, np.where(mt == 1, isna | (np.abs(xv) <= 1e-35), np.zeros_like(isna)))
+                miss = np.where(mt == 2, isna, np.where(mt == 1, isna | (np.abs(xv) <= K_ZERO_THRESHOLD), np.zeros_like(isna)))
                 xv = np.where(isna & (mt != 2), 0.0, xv)
                 gl = np.where(miss, dl, xv <= thr)
                 go_left[num_idx] = gl

@@ -234,3 +234,44 @@ def test_cli_refit_task(tmp_path):
     from sklearn.metrics import roc_auc_score
 
     assert roc_auc_score(y2, p_new) > 0.85
+
+
+def test_cli_serve_task_stdio(tmp_path, monkeypatch, capsys):
+    """task=serve: JSONL scoring loop over stdin/stdout (serving
+    registry behind the CLI; docs/SERVING.md). Parity with the Python
+    API on the same model file."""
+    import io
+    import json
+
+    rs = np.random.RandomState(5)
+    X = rs.randn(600, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y, free_raw_data=False), num_boost_round=4,
+    )
+    model = tmp_path / "m.txt"
+    bst.save_model(str(model))
+
+    reqs = [
+        {"op": "ping"},
+        {"op": "score", "rows": X[:3].tolist()},
+        {"op": "models"},
+        {"op": "quit"},
+    ]
+    monkeypatch.setattr(
+        "sys.stdin", io.StringIO("\n".join(json.dumps(r) for r in reqs))
+    )
+    rc = cli_main([
+        "task=serve", f"input_model={model}", "serve_warmup=false",
+        "serve_buckets=8,32", "verbosity=-1",
+    ])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    resp = [json.loads(l) for l in lines]
+    assert resp[0]["pong"]
+    np.testing.assert_allclose(resp[1]["pred"], bst.predict(X[:3]),
+                               rtol=1e-5, atol=1e-6)
+    assert resp[2]["models"]["default"]["active"] == 1
+    assert resp[3]["quit"]

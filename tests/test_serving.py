@@ -1,0 +1,577 @@
+"""TPU-resident inference & serving subsystem (lightgbm_tpu/serving).
+
+Parity contract: the tensorized device predictor must match the host
+walkers within 1e-5 on every model family — regression / binary /
+multiclass / ranking, categorical splits, NaN missing values, linear
+trees — on models round-tripped through the reference text format.
+Compile contract: the bucket-batched dispatcher compiles at most once
+per configured bucket across a 100-request mixed-size sequence
+(retrace-guard-asserted)."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (
+    BucketDispatcher,
+    MicroBatcher,
+    ModelRegistry,
+    ScoringServer,
+    TensorForest,
+)
+
+
+def _roundtrip(bst):
+    """Model -> reference text format -> fresh Booster (the serving
+    path always scores LOADED models, so parity is asserted on the
+    round-tripped artifact)."""
+    return lgb.Booster(model_str=bst.model_to_string())
+
+
+def _train(params, X, y, rounds=10, **ds_kw):
+    ds = lgb.Dataset(X, label=y, free_raw_data=False, **ds_kw)
+    p = dict(verbosity=-1, min_data_in_leaf=5)
+    p.update(params)
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+def _families(rng):
+    """(name, booster, scoring matrix) per model family."""
+    out = []
+    X = rng.randn(1500, 8)
+    yreg = X @ rng.randn(8) + 0.1 * rng.randn(1500)
+    out.append(("regression",
+                _train({"objective": "regression", "num_leaves": 31}, X, yreg),
+                rng.randn(400, 8)))
+
+    Xc = rng.randn(1500, 8)
+    Xc[:, 3] = rng.randint(0, 12, 1500)
+    Xc[rng.rand(1500) < 0.07, 1] = np.nan  # NaN missing type
+    yb = (np.nan_to_num(Xc[:, 0]) + (Xc[:, 3] % 3 == 0) > 0.3).astype(float)
+    Xq = rng.randn(400, 8)
+    Xq[:, 3] = rng.randint(-2, 20, 400)  # incl. unseen/negative cats
+    Xq[rng.rand(400) < 0.07, 1] = np.nan
+    out.append(("binary+cat+nan",
+                _train({"objective": "binary", "num_leaves": 31}, Xc, yb,
+                       categorical_feature=[3]),
+                Xq))
+
+    ym = rng.randint(0, 3, 1500)
+    out.append(("multiclass",
+                _train({"objective": "multiclass", "num_class": 3,
+                        "num_leaves": 15}, X, ym, rounds=6),
+                rng.randn(300, 8)))
+
+    yr = np.clip((X[:, 0] + 0.3 * rng.randn(1500)) * 2 + 2, 0, 4).astype(int)
+    group = np.full(30, 50)
+    out.append(("lambdarank",
+                _train({"objective": "lambdarank", "num_leaves": 15,
+                        "min_data_in_leaf": 2}, X, yr, rounds=6,
+                       group=group),
+                rng.randn(300, 8)))
+
+    Xl = rng.randn(1200, 5)
+    yl = Xl[:, 0] * 2 + Xl[:, 1] + 0.1 * rng.randn(1200)
+    Xl[rng.rand(1200) < 0.04, 1] = np.nan
+    Xlq = rng.randn(300, 5)
+    Xlq[rng.rand(300) < 0.04, 1] = np.nan
+    dsl = lgb.Dataset(Xl, label=yl, free_raw_data=False,
+                      params={"linear_tree": True})
+    out.append(("linear_tree",
+                lgb.train({"objective": "regression", "num_leaves": 15,
+                           "linear_tree": True, "verbosity": -1,
+                           "min_data_in_leaf": 5}, dsl, num_boost_round=8),
+                Xlq))
+    return out
+
+
+def test_device_predictor_parity_all_families(rng):
+    for name, bst, Xq in _families(rng):
+        loaded = _roundtrip(bst)
+        host = loaded._gbdt.predict_raw(Xq)
+        forest = TensorForest.from_booster(loaded)
+        dev = forest.predict_raw(Xq)
+        np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-5,
+                                   err_msg=name)
+        # and against the ORIGINAL (non-roundtripped) booster's walk —
+        # native when the toolchain exists, numpy level walk otherwise
+        np.testing.assert_allclose(dev, bst._gbdt.predict_raw(Xq),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)
+
+
+def test_device_pred_leaf_and_truncation(rng):
+    X = rng.randn(1200, 6)
+    y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y, rounds=9)
+    forest = TensorForest.from_booster(bst)
+    Xq = rng.randn(200, 6)
+    np.testing.assert_array_equal(
+        forest.predict_leaf(Xq), bst._gbdt.predict_leaf_index(Xq)
+    )
+    # num_iteration / start_iteration truncation
+    for start, num in ((0, 4), (2, 3), (5, -1)):
+        np.testing.assert_allclose(
+            forest.predict_raw(Xq, start, num),
+            bst._gbdt.predict_raw(Xq, start, num),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_array_equal(
+            forest.predict_leaf(Xq, start, num),
+            bst._gbdt.predict_leaf_index(Xq, start, num),
+        )
+
+
+def test_booster_predict_device_kwarg(rng):
+    X = rng.randn(1000, 6)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    np.testing.assert_allclose(
+        bst.predict(X[:100], device="tpu"), bst.predict(X[:100]),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        bst.predict(X[:100], device="tpu", raw_score=True),
+        bst.predict(X[:100], raw_score=True),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        bst.predict(X[:100], device="tpu", pred_leaf=True),
+        bst.predict(X[:100], pred_leaf=True),
+    )
+
+
+def test_narrow_input_raises_like_host(rng):
+    X = rng.randn(800, 6)
+    bst = _train({"objective": "regression", "num_leaves": 15}, X, X[:, 5])
+    forest = TensorForest.from_booster(bst)
+    assert forest.max_feature >= 2
+    with pytest.raises(IndexError):
+        forest.predict_raw(rng.randn(10, 2))
+
+
+# ---------------------------------------------------------------- dispatcher
+def test_dispatcher_parity_and_chunking(rng):
+    X = rng.randn(1500, 6)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    forest = TensorForest.from_booster(bst)
+    disp = BucketDispatcher(forest, buckets=(16, 64, 256))
+    host = bst._gbdt.predict_raw(X)
+    # oversized batch: chunked into max-bucket pieces + a padded tail
+    np.testing.assert_allclose(disp.score_raw(X), host,
+                               rtol=1e-5, atol=1e-5)
+    # 1-row latency path
+    np.testing.assert_allclose(disp.score_raw(X[7]), host[:, 7:8],
+                               rtol=1e-5, atol=1e-5)
+    s = disp.stats()
+    assert s["count"] == 2 and s["rows"] == 1501
+
+
+def test_dispatcher_compiles_bounded_by_buckets(retrace_guard, rng):
+    """THE serving compile contract: 100 mixed-size requests, at most
+    one compile per configured bucket (analysis/retrace.py guard on
+    the real jit entry's trace-cache)."""
+    X = rng.randn(2000, 7)
+    y = (X[:, 0] + X[:, 2] > 0).astype(float)
+    # deliberately odd tree count/size so this forest's table shapes
+    # are not already warm in the shared jit cache
+    bst = _train({"objective": "binary", "num_leaves": 23}, X, y, rounds=11)
+    forest = TensorForest.from_booster(bst)
+    buckets = (32, 128, 512)
+    disp = BucketDispatcher(forest, buckets=buckets)
+    sizes = [int(s) for s in rng.randint(1, 600, 100)]
+    with retrace_guard(
+        entry_points=[forest.jit_entry],
+        max_retraces=len(buckets),
+        what="bucket-batched scoring (100 mixed-size requests)",
+    ) as rep:
+        for n in sizes:
+            disp.score_raw(X[:n])
+    assert rep.per_entry  # the guard actually saw the entry point
+    # warmed up, the same traffic must not compile AT ALL
+    with retrace_guard(
+        entry_points=[forest.jit_entry], max_retraces=0,
+        what="warm bucket-batched scoring",
+    ):
+        for n in sizes[:20]:
+            disp.score_raw(X[:n])
+
+
+def test_dispatcher_warmup_precompiles(retrace_guard, rng):
+    X = rng.randn(600, 5)
+    bst = _train({"objective": "regression", "num_leaves": 19}, X, X[:, 0],
+                 rounds=7)
+    forest = TensorForest.from_booster(bst)
+    disp = BucketDispatcher(forest, buckets=(16, 64))
+    disp.warmup(num_features=5)
+    with retrace_guard(entry_points=[forest.jit_entry], max_retraces=0,
+                       what="post-warmup scoring"):
+        disp.score_raw(X[:10])
+        disp.score_raw(X[:60])
+
+
+def test_microbatcher_concurrent_submits(rng):
+    X = rng.randn(900, 6)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    forest = TensorForest.from_booster(bst)
+    disp = BucketDispatcher(forest, buckets=(64, 256))
+    mb = MicroBatcher(disp)
+    try:
+        futs = [mb.submit(X[i * 30: (i + 1) * 30]) for i in range(12)]
+        host = bst._gbdt.predict_raw(X[:360])
+        for i, f in enumerate(futs):
+            got = f.result(timeout=30)  # (n, K)
+            np.testing.assert_allclose(
+                got.T, host[:, i * 30: (i + 1) * 30], rtol=1e-5, atol=1e-5
+            )
+    finally:
+        mb.close()
+
+
+def test_sharded_forest_parity(rng):
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    from lightgbm_tpu.parallel.data_parallel import make_mesh
+
+    X = rng.randn(1000, 6)
+    y = rng.randint(0, 3, 1000)
+    bst = _train({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 15}, X, y, rounds=5)
+    host = bst._gbdt.predict_raw(X[:320])
+    forest = TensorForest.from_booster(bst, mesh=make_mesh())
+    assert forest.num_devices == jax.device_count()
+    np.testing.assert_allclose(forest.predict_raw(X[:320]), host,
+                               rtol=1e-5, atol=1e-5)
+    # dispatcher aligns bucket rungs to the mesh
+    disp = BucketDispatcher(forest, buckets=(10, 100))
+    assert all(b % forest.num_devices == 0 for b in disp.buckets)
+    np.testing.assert_allclose(disp.score_raw(X[:37]), host[:, :37],
+                               rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------------ registry
+def test_registry_load_swap_rollback(rng):
+    X = rng.randn(900, 5)
+    y = (X[:, 0] > 0).astype(float)
+    b1 = _train({"objective": "binary", "num_leaves": 15}, X, y, rounds=6)
+    b2 = _train({"objective": "binary", "num_leaves": 15}, X, y, rounds=12)
+    reg = ModelRegistry()
+    v1 = reg.load("m", b1.model_to_string())
+    assert v1 == 1 and reg.models()["m"]["active"] == 1
+    np.testing.assert_allclose(reg.predict("m", X[:50]), b1.predict(X[:50]),
+                               rtol=1e-5, atol=1e-6)
+    v2 = reg.load("m", b2.model_to_string())  # hot-swap activates v2
+    assert reg.models()["m"]["active"] == v2
+    np.testing.assert_allclose(reg.predict("m", X[:50]), b2.predict(X[:50]),
+                               rtol=1e-5, atol=1e-6)
+    assert reg.rollback("m") == v1
+    np.testing.assert_allclose(reg.predict("m", X[:50]), b1.predict(X[:50]),
+                               rtol=1e-5, atol=1e-6)
+    # pinned-version scoring regardless of the active pointer
+    np.testing.assert_allclose(reg.predict("m", X[:50], version=v2),
+                               b2.predict(X[:50]), rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError):
+        reg.unload("m", v1)  # active version is protected
+    reg.unload("m", v2)
+    assert [v["version"] for v in reg.models()["m"]["versions"]] == [v1]
+    with pytest.raises(KeyError):
+        reg.predict("nope", X[:5])
+
+
+def test_registry_json_model_roundtrip(rng):
+    """dump_model() JSON loads back (model_io.load_model_dict) and
+    scores identically — incl. categorical bitsets and missing types."""
+    X = rng.randn(1200, 6)
+    X[:, 2] = rng.randint(0, 9, 1200)
+    X[rng.rand(1200) < 0.05, 4] = np.nan
+    y = (np.nan_to_num(X[:, 4]) + (X[:, 2] % 2) > 0.4).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y,
+                 categorical_feature=[2])
+    reg = ModelRegistry()
+    reg.load("t", bst.model_to_string())
+    reg.load("j", bst.dump_model())
+    Xq = rng.randn(200, 6)
+    Xq[:, 2] = rng.randint(-1, 12, 200)
+    Xq[rng.rand(200) < 0.05, 4] = np.nan
+    np.testing.assert_allclose(reg.predict("t", Xq), reg.predict("j", Xq),
+                               rtol=0, atol=0)
+    np.testing.assert_allclose(reg.predict("j", Xq), bst.predict(Xq),
+                               rtol=1e-5, atol=1e-6)
+
+
+# -------------------------------------------------------------------- server
+def test_scoring_server_jsonl_protocol(rng):
+    X = rng.randn(800, 5)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    reg = ModelRegistry()
+    reg.load("default", bst.model_to_string())
+    reqs = [
+        {"op": "ping"},
+        {"op": "score", "rows": X[:4].tolist()},
+        {"op": "score", "rows": X[:4].tolist(), "raw_score": True},
+        {"op": "score", "model": "missing", "rows": [[0.0] * 5]},
+        {"op": "models"},
+        {"op": "stats"},
+        {"op": "quit"},
+    ]
+    sin = io.StringIO("\n".join(json.dumps(r) for r in reqs) + "\n")
+    sout = io.StringIO()
+    assert ScoringServer(reg).serve(sin, sout) == len(reqs)
+    resp = [json.loads(line) for line in sout.getvalue().splitlines()]
+    assert resp[0] == {"ok": True, "pong": True}
+    np.testing.assert_allclose(resp[1]["pred"], bst.predict(X[:4]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(resp[2]["pred"],
+                               bst.predict(X[:4], raw_score=True),
+                               rtol=1e-5, atol=1e-6)
+    assert not resp[3]["ok"] and "missing" in resp[3]["error"]
+    assert resp[4]["models"]["default"]["active"] == 1
+    assert resp[5]["stats"]["default"]["count"] >= 2
+    assert resp[6]["quit"]
+    # bad JSON must produce an error line, not kill the loop
+    sout2 = io.StringIO()
+    ScoringServer(reg).serve(io.StringIO("not json\n"), sout2)
+    assert not json.loads(sout2.getvalue())["ok"]
+
+
+def test_server_load_and_swap_ops(rng, tmp_path):
+    X = rng.randn(700, 4)
+    bst = _train({"objective": "regression", "num_leaves": 15}, X, X[:, 0])
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    reqs = [
+        {"op": "load", "model": "m", "path": str(path)},
+        {"op": "load", "model": "m", "model_str": bst.model_to_string()},
+        {"op": "swap", "model": "m", "version": 1},
+        {"op": "rollback", "model": "m"},  # nothing below v1 -> error
+        {"op": "score", "model": "m", "rows": X[:2].tolist()},
+    ]
+    sin = io.StringIO("\n".join(json.dumps(r) for r in reqs) + "\n")
+    sout = io.StringIO()
+    ScoringServer(ModelRegistry()).serve(sin, sout)
+    resp = [json.loads(line) for line in sout.getvalue().splitlines()]
+    assert resp[0]["version"] == 1 and resp[1]["version"] == 2
+    assert resp[2]["ok"] and resp[2]["active"] == 1
+    assert not resp[3]["ok"]
+    np.testing.assert_allclose(resp[4]["pred"], bst.predict(X[:2]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_latency_stats_counters():
+    from lightgbm_tpu.timer import LatencyStats
+
+    ls = LatencyStats(window=8)
+    for ms in (1, 2, 3, 4, 100):
+        ls.observe(ms / 1e3, rows=10)
+    s = ls.snapshot()
+    assert s["count"] == 5 and s["rows"] == 50
+    assert s["p50_ms"] == pytest.approx(3.0, abs=0.01)
+    assert s["p99_ms"] == pytest.approx(100.0, abs=0.01)
+    assert s["mean_ms"] == pytest.approx(22.0, abs=0.01)
+    ls.reset()
+    assert ls.snapshot()["count"] == 0
+
+
+def test_http_front_end(rng):
+    """serve_http: same vocabulary over POST /v1/<op> + health/stats
+    GETs, on an ephemeral port."""
+    import threading
+    import urllib.request
+
+    from lightgbm_tpu.serving import serve_http
+
+    X = rng.randn(600, 4)
+    bst = _train({"objective": "regression", "num_leaves": 15}, X, X[:, 0])
+    reg = ModelRegistry()
+    reg.load("default", bst.model_to_string())
+    httpd = serve_http(reg, port=0, block=False)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            assert json.loads(r.read())["ok"]
+        out = post("/v1/score", {"rows": X[:5].tolist()})
+        np.testing.assert_allclose(out["pred"], bst.predict(X[:5]),
+                                   rtol=1e-5, atol=1e-6)
+        with urllib.request.urlopen(base + "/v1/models", timeout=30) as r:
+            assert json.loads(r.read())["models"]["default"]["active"] == 1
+        # errors come back as JSON with ok=false, status 400
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/v1/score", {"model": "missing", "rows": [[0.0] * 4]})
+        assert ei.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=5)
+
+
+def test_registry_linear_tree_json_roundtrip(rng):
+    """dump_model() on a linear-tree model carries the linear-leaf
+    extension keys and loads back to identical predictions (a silent
+    leaf-const fallback here once shipped wrong scores)."""
+    X = rng.randn(1200, 5)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.randn(1200)
+    X[rng.rand(1200) < 0.04, 1] = np.nan
+    ds = lgb.Dataset(X, label=y, free_raw_data=False,
+                     params={"linear_tree": True})
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "linear_tree": True, "verbosity": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=8)
+    d = bst.dump_model()
+    assert d["tree_info"][0]["is_linear"]
+    reg = ModelRegistry()
+    reg.load("j", d)
+    Xq = rng.randn(200, 5)
+    Xq[rng.rand(200) < 0.04, 1] = np.nan
+    np.testing.assert_allclose(reg.predict("j", Xq), bst.predict(Xq),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_registry_pred_leaf_rides_bucket_ladder(retrace_guard, rng):
+    """pred_leaf through the registry must use the bucket ladder too —
+    mixed-size leaf requests compile at most once per rung."""
+    X = rng.randn(1200, 6)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 27}, X, y, rounds=9)
+    reg = ModelRegistry(buckets=(32, 128))
+    reg.load("m", bst.model_to_string())
+    forest = reg._entry("m").forest
+    sizes = [int(s) for s in rng.randint(1, 200, 30)]
+    with retrace_guard(entry_points=[forest.jit_entry], max_retraces=2,
+                       what="pred_leaf mixed sizes"):
+        for n in sizes:
+            out = reg.predict("m", X[:n], pred_leaf=True)
+            np.testing.assert_array_equal(
+                out, bst._gbdt.predict_leaf_index(X[:n])
+            )
+
+
+def test_registry_predict_via_queue(rng):
+    X = rng.randn(800, 5)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    reg = ModelRegistry()
+    reg.load("m", bst.model_to_string())
+    np.testing.assert_allclose(
+        reg.predict("m", X[:40], via_queue=True), bst.predict(X[:40]),
+        rtol=1e-5, atol=1e-6,
+    )
+    # truncated requests bypass the queue but still answer correctly
+    np.testing.assert_allclose(
+        reg.predict("m", X[:40], via_queue=True, num_iteration=3,
+                    raw_score=True),
+        bst.predict(X[:40], num_iteration=3, raw_score=True),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_dispatcher_empty_batch(rng):
+    X = rng.randn(500, 5)
+    bst = _train({"objective": "regression", "num_leaves": 15}, X, X[:, 0])
+    reg = ModelRegistry()
+    reg.load("m", bst.model_to_string())
+    out = reg.predict("m", np.zeros((0, 5)))
+    assert out.shape == (0,)
+    leaf = reg.predict("m", np.zeros((0, 5)), pred_leaf=True)
+    assert leaf.shape == (0, bst.num_trees())
+
+
+def test_registry_path_named_like_model_string(rng, tmp_path):
+    """A model FILE whose path starts with 'tree' must load as a file,
+    not be parsed as an inline model string."""
+    X = rng.randn(500, 4)
+    bst = _train({"objective": "regression", "num_leaves": 7}, X, X[:, 0],
+                 rounds=3)
+    path = tmp_path / "tree_v2.txt"
+    bst.save_model(str(path))
+    reg = ModelRegistry()
+    reg.load("m", str(path))
+    np.testing.assert_allclose(reg.predict("m", X[:10]), bst.predict(X[:10]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_unload_closes_microbatcher(rng):
+    X = rng.randn(500, 4)
+    bst = _train({"objective": "regression", "num_leaves": 7}, X, X[:, 0],
+                 rounds=3)
+    reg = ModelRegistry()
+    reg.load("m", bst.model_to_string())
+    reg.predict("m", X[:10], via_queue=True)  # lazily creates the batcher
+    mv = reg._entry("m")
+    assert mv.batcher is not None and mv.batcher._worker.is_alive()
+    reg.unload("m")
+    assert not mv.batcher._worker.is_alive()
+
+
+def test_serve_buckets_default_matches_dispatch():
+    """The ladder is single-sourced in config.DEFAULT_SERVE_BUCKETS
+    (dispatch imports it — config is the leaf module, so the reverse
+    import would cycle); this pins the re-export so a future literal
+    cannot drift."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serving import DEFAULT_BUCKETS
+
+    assert tuple(Config({}).serve_buckets) == DEFAULT_BUCKETS
+
+
+def test_registry_warmup_covers_model_width(retrace_guard, rng):
+    """Warmup must precompile at the model's DECLARED width, not
+    max_feature+1 — a model that never splits its last features would
+    otherwise recompile every bucket on the first real batch."""
+    X = np.concatenate([rng.randn(600, 1), np.ones((600, 5))], axis=1)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 7}, X, y, rounds=5)
+    reg = ModelRegistry(buckets=(16, 64), warmup=True)
+    reg.load("m", bst.model_to_string())
+    forest = reg._entry("m").forest
+    assert forest.max_feature + 1 < 6  # the gap this test exists for
+    with retrace_guard(entry_points=[forest.jit_entry], max_retraces=0,
+                       what="post-warmup full-width scoring"):
+        reg.predict("m", X[:10])
+        reg.predict("m", X[:60])
+
+
+def test_threshold_f32_cast_never_rounds_up(rng):
+    """pack_forest_tables must cast f64 thresholds to f32 with DIRECTED
+    (downward) rounding: a threshold just below an exactly-f32 feature
+    value that round-to-nearest would round UP flips that value from
+    right (f64 host compare) to left on device — a whole-leaf
+    divergence, not 1e-5 noise."""
+    X = rng.randn(400, 3)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "regression", "num_leaves": 5}, X, y, rounds=1)
+    t = bst._gbdt.models[0]
+    # hostile root split: f32(1.0 - 1e-12) rounds to exactly 1.0
+    t.split_feature[0] = 0
+    t.threshold[0] = 1.0 - 1e-12
+    t.decision_type[0] = 0  # numerical, no missing handling
+    assert np.float32(t.threshold[0]) == np.float32(1.0)
+    Xp = np.zeros((3, 3), np.float32)
+    Xp[0, 0] = 1.0   # exactly f32, must go RIGHT of the root split
+    Xp[1, 0] = 0.5   # well left
+    Xp[2, 0] = 2.0   # well right
+    host_leaf = t.predict_leaf(Xp.astype(np.float64))
+    forest = TensorForest([t], 1)
+    dev_leaf = forest.predict_leaf(Xp)[:, 0]
+    assert np.array_equal(dev_leaf, host_leaf)
+    assert np.abs(
+        forest.predict_raw(Xp)[0] - t.predict(Xp.astype(np.float64))
+    ).max() < 1e-6

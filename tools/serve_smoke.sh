@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# End-to-end serving smoke test (docs/SERVING.md): train a tiny model
+# through the CLI, start the task=serve JSONL loop, score a batch
+# through it, and assert parity against Booster.predict on the same
+# model file. Runs on the CPU backend so it is safe anywhere.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+
+python - "$WORK" <<'EOF'
+import sys
+import numpy as np
+
+work = sys.argv[1]
+rs = np.random.RandomState(0)
+X = rs.randn(800, 5)
+y = (X[:, 0] + X[:, 1] > 0).astype(int)
+np.savetxt(f"{work}/train.csv",
+           np.column_stack([y, X]), delimiter=",", fmt="%.6g")
+np.savetxt(f"{work}/score.csv", X[:64, :], delimiter=",", fmt="%.6g")
+EOF
+
+python -m lightgbm_tpu task=train "data=$WORK/train.csv" \
+    objective=binary num_leaves=15 num_trees=10 verbosity=-1 \
+    "output_model=$WORK/model.txt"
+
+python - "$WORK" <<'EOF'
+import io
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+work = sys.argv[1]
+rows = np.loadtxt(f"{work}/score.csv", delimiter=",").tolist()
+reqs = "\n".join(json.dumps(r) for r in [
+    {"op": "ping"},
+    {"op": "score", "model": "default", "rows": rows},
+    {"op": "stats"},
+    {"op": "quit"},
+])
+proc = subprocess.run(
+    [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+     f"input_model={work}/model.txt", "serve_buckets=16,64",
+     "verbosity=-1"],
+    input=reqs, capture_output=True, text=True, timeout=300,
+)
+assert proc.returncode == 0, proc.stderr[-2000:]
+resp = [json.loads(l) for l in proc.stdout.splitlines()
+        if l.startswith("{")]
+assert resp[0]["pong"], resp[0]
+served = np.asarray(resp[1]["pred"])
+assert resp[2]["stats"]["default"]["count"] >= 1
+
+# parity vs the Python API on the same model file
+import lightgbm_tpu as lgb
+
+bst = lgb.Booster(model_file=f"{work}/model.txt")
+host = bst.predict(np.asarray(rows))
+err = float(np.abs(served - host).max())
+assert err < 1e-5, f"serve/host mismatch: {err}"
+print(f"serve_smoke: OK ({len(rows)} rows scored, max |diff| {err:.2e})")
+EOF
