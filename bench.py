@@ -18,8 +18,11 @@ r2/r3 died on TPU-tunnel hangs and timeouts):
   inside the driver budget;
 - SIGTERM/SIGINT/SIGALRM all trigger the final JSON line, built from
   whatever partial results exist at that moment (stage field says how
-  far it got); partial state is also persisted to bench_partial.json
-  as training advances;
+  far it got); partial state is also persisted to a per-run file under
+  a tmp run dir (BENCH_RUN_DIR, default <tmpdir>/lightgbm_tpu_bench/)
+  as training advances — never to the repo root, and the partial is
+  removed on a clean finish so aborted runs cannot leave stale
+  artifacts behind for the next session to misread;
 - the last builder-verified on-chip number (BENCH_NOTES.md) rides along
   in "last_tpu_verified" so a CPU-fallback artifact still carries the
   hardware result.
@@ -31,8 +34,8 @@ trees/sec with live eval is the number that matters for users.
 
 Env overrides: BENCH_ROWS, BENCH_FEATURES, BENCH_LEAVES, BENCH_TREES,
 BENCH_WARMUP, BENCH_MAX_BIN, BENCH_PROBE_TIMEOUT (s), BENCH_PROBE_RETRIES,
-BENCH_FORCE_CPU,
-BENCH_CPU_ROWS, BENCH_GROWTH_MODE, BENCH_BUDGET (s, SIGALRM deadline).
+BENCH_FORCE_CPU, BENCH_CPU_ROWS, BENCH_GROWTH_MODE,
+BENCH_BUDGET (s, SIGALRM deadline), BENCH_RUN_DIR (partial-state dir).
 """
 
 import json
@@ -40,11 +43,29 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _partial_path() -> str:
+    """Per-run partial-state file under a tmp run dir — NOT the repo
+    root (an aborted run once left a stale bench_partial.json checked
+    in, which read as a fresh artifact forever after)."""
+    run_dir = os.environ.get("BENCH_RUN_DIR") or os.path.join(
+        tempfile.gettempdir(), "lightgbm_tpu_bench"
+    )
+    try:
+        os.makedirs(run_dir, exist_ok=True)
+    except OSError:
+        run_dir = tempfile.gettempdir()
+    return os.path.join(run_dir, f"bench_partial_{os.getpid()}.json")
+
+
+_PARTIAL_PATH = _partial_path()
 
 # last builder-verified on-chip measurement (see BENCH_NOTES.md);
 # updated whenever a live-chip run lands a better sustained number
@@ -188,10 +209,20 @@ def _watchdog(deadline: float):
 def save_partial(**kw):
     _STATE.update(kw)
     try:
-        with open(os.path.join(REPO, "bench_partial.json"), "w") as f:
+        with open(_PARTIAL_PATH, "w") as f:
             json.dump(
                 dict(_STATE, last_tpu_verified=_tpu_verified()), f
             )
+    except OSError:
+        pass
+
+
+def _cleanup_partial():
+    """Drop the partial file on a clean finish (the final JSON line on
+    stdout is the artifact); an aborted run keeps its partial in the
+    tmp run dir for postmortem, where it can't be mistaken for output."""
+    try:
+        os.remove(_PARTIAL_PATH)
     except OSError:
         pass
 
@@ -413,7 +444,8 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] quantized segment failed: {e}\n")
 
-    save_partial(stage="done")
+    _STATE["stage"] = "done"
+    _cleanup_partial()
     _emit_final()
 
 

@@ -8,34 +8,61 @@ constants baked into cached traced steps — and every one of them is
 detectable BEFORE runtime by inspecting source ASTs and jaxprs. Three
 cooperating passes (docs/STATIC_ANALYSIS.md):
 
-- `lint`        AST linter for JAX hazards inside traced code paths
-- `jaxpr_audit` abstract-traces the hot entry points and asserts
-                machine-checkable contracts (int32 wire, no host
-                callbacks, executable-size budgets)
-- `retrace`     runtime jit-cache-miss guard (context manager + pytest
-                fixture) with `jax.checking_leaks` wired in
+- `lint`             AST linter for JAX hazards inside traced code paths
+- `concurrency_lint` AST lock-discipline linter for the threaded
+                     serving layer (unlocked shared writes, lock-order
+                     inversions, per-call primitives, blocking under
+                     a lock)
+- `jaxpr_audit`      abstract-traces the hot entry points and asserts
+                     machine-checkable contracts (quant wire dtype, no
+                     host callbacks, executable-size budgets)
+- `cost_audit`       lowers-and-COMPILES the same entries on CPU and
+                     checks XLA cost/memory analysis + collective
+                     wire-bytes against checked-in budgets
+                     (cost_budget.json)
+- `retrace`          runtime jit-cache-miss guard (context manager +
+                     pytest fixture) with `jax.checking_leaks` wired in
+- `passes`           the registry every `--strict` run must exercise
 
 Run `python -m lightgbm_tpu.analysis --strict` (CI hook), or use the
 pieces directly:
 
     from lightgbm_tpu.analysis import lint_package, run_audits
+    from lightgbm_tpu.analysis.concurrency_lint import concurrency_lint_package
     from lightgbm_tpu.analysis.retrace import retrace_guard
 """
 
+from .concurrency_lint import (
+    CONCURRENCY_RULES,
+    concurrency_lint_package,
+    concurrency_lint_source,
+)
 from .lint import Finding, RULES, lint_package, lint_source, format_findings
 
 __all__ = [
     "Finding",
     "RULES",
+    "CONCURRENCY_RULES",
     "lint_package",
     "lint_source",
+    "concurrency_lint_package",
+    "concurrency_lint_source",
     "format_findings",
     "run_audits",
+    "run_cost_audits",
 ]
 
 
 def run_audits(*args, **kwargs):
     """Lazy forward to jaxpr_audit.run_audits (imports jax)."""
     from .jaxpr_audit import run_audits as _run
+
+    return _run(*args, **kwargs)
+
+
+def run_cost_audits(*args, **kwargs):
+    """Lazy forward to cost_audit.run_cost_audits (imports + compiles
+    under jax)."""
+    from .cost_audit import run_cost_audits as _run
 
     return _run(*args, **kwargs)

@@ -1,0 +1,554 @@
+"""Concurrency linter: lock-discipline rules for the threaded serving
+layer (serving/dispatch.py, registry.py, server.py — and anything else
+in the package that grows threads).
+
+Pure stdlib AST, same architecture and suppression syntax as the
+trace-safety linter (`# lint: allow[rule-id]`, file-wide
+`# lint: allow-file[rule-id]` in the first 10 lines — lint.py owns the
+comment scanner). The serving layer scores requests from
+ThreadingHTTPServer request threads plus the MicroBatcher worker, so
+lock-discipline regressions are production incidents (a swap that
+tears, a registry stats call that deadlocks a scoring thread), and —
+like the trace hazards — every one of them is visible in the source
+AST before any traffic exists.
+
+Lock model: a class OWNS the threading primitives it assigns to
+attributes (``self._lock = threading.Lock()``); a module owns its
+module-level primitives. Within a function, ``with <lock>:`` tracks
+the held set lexically; calls to sibling methods / module functions
+propagate both "locks this call may acquire" and "this call may
+block" one call graph deep (to a fixpoint).
+
+Rules:
+
+- ``unlocked-write`` — an attribute written under the class lock in
+  some methods is shared mutable state; writing it elsewhere without
+  the lock (outside ``__init__``, where the object is still
+  thread-private) is a torn-state hazard.
+- ``lock-order`` — two locks acquired in opposite nesting orders
+  across the module's call graph (classic AB/BA deadlock), or a plain
+  non-reentrant ``Lock``/``Semaphore`` re-acquired while already held
+  (self-deadlock; ``RLock``/``Condition`` are reentrant and exempt).
+- ``per-call-lock`` — a threading primitive constructed inside a
+  regular function/method (anything but ``__init__``-likes and
+  module/class scope): a lock created per call guards nothing.
+- ``blocking-under-lock`` — a blocking call (``sleep``, thread/process
+  ``join``, ``Future.result``, ``subprocess`` waits,
+  ``block_until_ready``, ``serve_forever``, socket accept/recv, or a
+  local call that transitively blocks) made while holding a lock:
+  every other thread needing that lock stalls behind the wait.
+  ``cond.wait()`` on the very condition being held is the coalescing
+  idiom and exempt (wait releases the lock).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+from .lint import Finding, Rule, _dotted, scan_allow_comments
+
+CONCURRENCY_RULES: Dict[str, Rule] = {}
+
+
+def _register(rule_id: str, summary: str) -> str:
+    CONCURRENCY_RULES[rule_id] = Rule(rule_id, summary)
+    return rule_id
+
+
+UNLOCKED_WRITE = _register(
+    "unlocked-write",
+    "shared mutable attribute (written under the owning lock elsewhere "
+    "in the class) written without the lock — torn state under "
+    "concurrent serving threads",
+)
+LOCK_ORDER = _register(
+    "lock-order",
+    "lock acquisition-order inversion across methods (AB/BA deadlock), "
+    "or a non-reentrant Lock re-acquired while already held",
+)
+PER_CALL_LOCK = _register(
+    "per-call-lock",
+    "threading primitive created inside a per-call function instead of "
+    "per-instance (__init__) or module scope — a fresh lock guards "
+    "nothing",
+)
+BLOCKING_UNDER_LOCK = _register(
+    "blocking-under-lock",
+    "blocking call while holding a lock — every thread needing the "
+    "lock stalls behind the wait (move the slow work outside the "
+    "critical section)",
+)
+
+# primitive constructors; value = reentrant? (safe to re-acquire)
+_LOCK_KINDS: Dict[str, bool] = {
+    "Lock": False,
+    "RLock": True,
+    "Condition": True,   # wraps an RLock by default
+    "Semaphore": False,
+    "BoundedSemaphore": False,
+}
+_PRIMITIVE_CTORS = set(_LOCK_KINDS) | {"Event", "Barrier"}
+_INIT_METHODS = {"__init__", "__new__", "__post_init__", "__set_name__"}
+# method calls that mutate their receiver (self.attr.append(...) is a
+# write to attr just like self.attr = ...)
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "appendleft", "popleft",
+    "sort", "reverse",
+}
+# dotted-leaf names that block the calling thread
+_BLOCKING_LEAVES = {
+    "sleep", "result", "communicate", "serve_forever",
+    "block_until_ready", "accept", "recv", "recvfrom", "select",
+    "check_call", "check_output",
+}
+# subprocess.<leaf> that wait for the child
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+
+
+class _FnSummary(NamedTuple):
+    qualname: str
+    node: ast.AST
+    cls: Optional[str]
+    acquires: Set[str]        # lock ids `with`-acquired anywhere inside
+    blocking_other: bool      # contains a non-wait blocking call
+    waits: Set[str]           # known locks/conditions this fn waits on
+    calls: Set[str]           # local callee keys (resolved later)
+
+
+class _ConcurrencyLinter:
+    """One module at a time; the lock namespace (self.X per class,
+    module-level names) does not usefully cross modules."""
+
+    def __init__(self, tree: ast.Module, src: str, path: str):
+        self.tree = tree
+        self.path = path
+        self.allow_lines, self.allow_file = scan_allow_comments(src)
+        self.findings: List[Finding] = []
+        # lock id -> reentrant? ; ids are "self.X" scoped per class
+        # ("Cls::self.X") and bare module names ("name")
+        self.locks: Dict[str, bool] = {}
+        self.fns: Dict[str, _FnSummary] = {}   # key "Cls.meth" | "fn"
+        # class -> attr -> [(node, fn_key, held frozenset, in_init)]
+        self.writes: Dict[str, Dict[str, List[tuple]]] = {}
+        # acquisition edges: (held, acquired) -> first (node, fn_key)
+        self.edges: Dict[Tuple[str, str], tuple] = {}
+
+    # ------------------------------------------------------------ utils
+    def _lock_kind(self, call: ast.AST) -> Optional[str]:
+        """'Lock' / 'Condition' / ... when `call` constructs a
+        threading primitive (threading.X() or bare imported X())."""
+        if not isinstance(call, ast.Call):
+            return None
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        leaf = parts[-1]
+        if leaf not in _PRIMITIVE_CTORS:
+            return None
+        if len(parts) == 1 or parts[0] in ("threading", "multiprocessing"):
+            return leaf
+        return None
+
+    def _lock_id(self, node: ast.AST, cls: Optional[str]) -> Optional[str]:
+        """Known-lock id for an expression used in `with <expr>:` —
+        class locks are scoped so same-named attrs in two classes stay
+        distinct."""
+        d = _dotted(node)
+        if d is None:
+            return None
+        if d.startswith("self.") and cls is not None:
+            lid = f"{cls}::{d}"
+            return lid if lid in self.locks else None
+        return d if d in self.locks else None
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        sup = rule in self.allow_file or any(
+            rule in self.allow_lines.get(ln, ())
+            for ln in (line, line - 1)
+        )
+        self.findings.append(
+            Finding(rule, self.path, line, col, message, sup)
+        )
+
+    # ------------------------------------------------------- collection
+    def _collect_locks(self) -> None:
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                kind = self._lock_kind(stmt.value)
+                if kind in _LOCK_KINDS:
+                    self.locks[stmt.targets[0].id] = _LOCK_KINDS[kind]
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for n in ast.walk(node):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                    t = n.targets[0]
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        kind = self._lock_kind(n.value)
+                        if kind in _LOCK_KINDS:
+                            self.locks[f"{node.name}::self.{t.attr}"] = \
+                                _LOCK_KINDS[kind]
+
+    def _collect_fns(self) -> None:
+        def visit(node: ast.AST, cls: Optional[str], prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    key = f"{prefix}{child.name}"
+                    self.fns[key] = self._summarize_fn(child, cls, key)
+                    visit(child, cls, key + ".")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name, child.name + ".")
+
+        visit(self.tree, None, "")
+
+    def _classify_call(self, call: ast.Call, cls: Optional[str]):
+        """None for non-blocking calls, else (kind, lock_id, message):
+        kind "wait" with lock_id set when the receiver is a known
+        lock/condition (exempt while that lock is held — wait releases
+        it), kind "block" otherwise."""
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        leaf = parts[-1]
+        if leaf == "wait":
+            recv = d.rsplit(".", 1)[0] if len(parts) > 1 else None
+            lid = None
+            if recv is not None:
+                if cls is not None and f"{cls}::{recv}" in self.locks:
+                    lid = f"{cls}::{recv}"
+                elif recv in self.locks:
+                    lid = recv
+            return ("wait", lid, f"{d}() waits while the lock is held")
+        if leaf == "join":
+            # str.join is everywhere: only flag thread/process-style
+            # joins — zero positional args (or a timeout kwarg), and
+            # never on a string constant
+            if isinstance(call.func, ast.Attribute) \
+                    and isinstance(call.func.value, ast.Constant):
+                return None
+            if not call.args or any(k.arg == "timeout"
+                                    for k in call.keywords):
+                return ("block", None,
+                        f"{d}() joins a thread/process under the lock")
+            return None
+        if leaf in _SUBPROCESS_BLOCKING and len(parts) > 1 \
+                and parts[0] == "subprocess":
+            return ("block", None,
+                    f"{d}() waits for a subprocess under the lock")
+        if leaf in _BLOCKING_LEAVES:
+            return ("block", None, f"{d}() blocks while the lock is held")
+        return None
+
+    def _is_blocking_call(self, call: ast.Call, cls: Optional[str],
+                          held: Sequence[str]) -> Optional[str]:
+        """Reason string when `call` blocks given the held set; None
+        otherwise (a wait on a held condition is the coalescing
+        idiom)."""
+        k = self._classify_call(call, cls)
+        if k is None:
+            return None
+        kind, lid, msg = k
+        if kind == "wait" and lid is not None and lid in held:
+            return None
+        return msg
+
+    def _summarize_fn(self, fn: ast.AST, cls: Optional[str],
+                      key: str) -> _FnSummary:
+        acquires: Set[str] = set()
+        waits: Set[str] = set()
+        blocking_other = False
+        calls: Set[str] = set()
+        for n in self._walk_scope(fn):
+            if isinstance(n, ast.With):
+                for item in n.items:
+                    lid = self._lock_id(item.context_expr, cls)
+                    if lid is not None:
+                        acquires.add(lid)
+            elif isinstance(n, ast.Call):
+                k = self._classify_call(n, cls)
+                if k is not None:
+                    kind, lid, _msg = k
+                    if kind == "wait" and lid is not None:
+                        waits.add(lid)
+                    else:
+                        blocking_other = True
+                f = n.func
+                if isinstance(f, ast.Name):
+                    calls.add(f.id)
+                elif isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self" and cls is not None:
+                    calls.add(f"{cls}.{f.attr}")
+        return _FnSummary(key, fn, cls, acquires, blocking_other, waits,
+                          calls)
+
+    @staticmethod
+    def _walk_scope(fn_node: ast.AST):
+        """Walk WITHOUT descending into nested defs/classes (each is
+        summarized separately; a worker closure's waits are its own)."""
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _resolve(self, caller: _FnSummary, name: str) -> Optional[_FnSummary]:
+        """Callee summary for a call recorded by _summarize_fn
+        ("Cls.meth" from self.meth calls, bare module-function names)."""
+        return self.fns.get(name)
+
+    def _callee_for(self, s: _FnSummary, call: ast.Call
+                    ) -> Optional[_FnSummary]:
+        """Callee summary for a call expression inside `s`."""
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        if d.startswith("self.") and s.cls is not None:
+            return self.fns.get(f"{s.cls}.{d[len('self.'):]}")
+        if "." not in d:
+            return self.fns.get(d)
+        return None
+
+    def _close_summaries(self) -> None:
+        """Propagate acquires/blocking through local calls to fixpoint
+        (native.get_lib -> _build -> subprocess.run is two hops)."""
+        changed = True
+        while changed:
+            changed = False
+            for key, s in list(self.fns.items()):
+                acq, waits = set(s.acquires), set(s.waits)
+                blk = s.blocking_other
+                for cname in s.calls:
+                    callee = self._resolve(s, cname)
+                    if callee is None:
+                        continue
+                    acq |= callee.acquires
+                    waits |= callee.waits
+                    blk = blk or callee.blocking_other
+                if acq != s.acquires or blk != s.blocking_other \
+                        or waits != s.waits:
+                    self.fns[key] = s._replace(
+                        acquires=acq, blocking_other=blk, waits=waits
+                    )
+                    changed = True
+
+    # ----------------------------------------------------------- rules
+    def _scan_fn(self, s: _FnSummary) -> None:
+        """Single lexical pass with a held-lock stack, firing
+        per-call-lock / blocking-under-lock / lock-order self+cross
+        edges and recording attribute writes."""
+        cls = s.cls
+        is_init = s.qualname.split(".")[-1] in _INIT_METHODS
+
+        def record_write(attr: str, node: ast.AST, held: Tuple[str, ...]):
+            if cls is None:
+                return
+            self.writes.setdefault(cls, {}).setdefault(attr, []).append(
+                (node, s.qualname, frozenset(held), is_init)
+            )
+
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # nested scopes are scanned as their own fns
+            if isinstance(node, ast.With):
+                new_held = list(held)
+                for item in node.items:
+                    lid = self._lock_id(item.context_expr, cls)
+                    if lid is None:
+                        visit(item.context_expr, tuple(held))
+                        continue
+                    if lid in new_held:
+                        if not self.locks.get(lid, True):
+                            self._emit(
+                                LOCK_ORDER, item.context_expr,
+                                f"non-reentrant lock {lid.split('::')[-1]} "
+                                "re-acquired while already held — "
+                                "self-deadlock",
+                            )
+                    else:
+                        for h in new_held:
+                            self.edges.setdefault(
+                                (h, lid), (item.context_expr, s.qualname)
+                            )
+                        new_held.append(lid)
+                for stmt in node.body:
+                    visit(stmt, tuple(new_held))
+                return
+            if isinstance(node, ast.Call):
+                kind = self._lock_kind(node)
+                if kind is not None and not is_init:
+                    self._emit(
+                        PER_CALL_LOCK, node,
+                        f"threading.{kind}() created in "
+                        f"{s.qualname!r} — per-call primitives "
+                        "synchronize nothing; create in __init__ "
+                        "or at module scope",
+                    )
+                if held:
+                    why = self._is_blocking_call(node, cls, held)
+                    callee = self._callee_for(s, node)
+                    if why is None and callee is not None:
+                        # a callee waiting ONLY on a condition the
+                        # caller holds is the coalescing idiom moved
+                        # into a helper — still exempt
+                        pending = callee.waits - set(held)
+                        if callee.blocking_other:
+                            why = (f"call to {_dotted(node.func)}() "
+                                   "which blocks (transitively)")
+                        elif pending:
+                            locks = ", ".join(
+                                sorted(p.split("::")[-1] for p in pending)
+                            )
+                            why = (f"call to {_dotted(node.func)}() "
+                                   f"which waits on {locks} "
+                                   "(transitively)")
+                    if why is not None:
+                        self._emit(
+                            BLOCKING_UNDER_LOCK, node,
+                            f"{why} [holding "
+                            f"{', '.join(h.split('::')[-1] for h in held)}]",
+                        )
+                    # cross-method acquisition edges
+                    if callee is not None:
+                        for lid in callee.acquires:
+                            if lid in held:
+                                if not self.locks.get(lid, True):
+                                    self._emit(
+                                        LOCK_ORDER, node,
+                                        f"non-reentrant lock "
+                                        f"{lid.split('::')[-1]} "
+                                        f"re-acquired via "
+                                        f"{callee.qualname}() — "
+                                        "self-deadlock",
+                                    )
+                            else:
+                                for h in held:
+                                    self.edges.setdefault(
+                                        (h, lid), (node, s.qualname)
+                                    )
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS:
+                    recv = node.func.value
+                    if (isinstance(recv, ast.Attribute)
+                            and isinstance(recv.value, ast.Name)
+                            and recv.value.id == "self"):
+                        record_write(recv.attr, node, held)
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets \
+                    if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if (isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id == "self"):
+                        record_write(base.attr, node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for child in ast.iter_child_nodes(s.node):
+            visit(child, ())
+
+    def _check_unlocked_writes(self) -> None:
+        for cls, attrs in self.writes.items():
+            class_locks = {
+                lid for lid in self.locks if lid.startswith(f"{cls}::")
+            }
+            if not class_locks:
+                continue
+            for attr, events in attrs.items():
+                owners = set()
+                for _node, _fn, held, in_init in events:
+                    if not in_init:
+                        owners |= held & class_locks
+                if not owners:
+                    continue
+                for node, fn, held, in_init in events:
+                    if in_init or held & owners:
+                        continue
+                    names = ", ".join(
+                        sorted(o.split("::")[-1] for o in owners)
+                    )
+                    self._emit(
+                        UNLOCKED_WRITE, node,
+                        f"self.{attr} is written under {names} elsewhere "
+                        f"in {cls} but written here ({fn}) without it",
+                    )
+
+    def _check_lock_order(self) -> None:
+        seen: Set[Tuple[str, str]] = set()
+        for (a, b), (node, fn) in sorted(
+            self.edges.items(), key=lambda kv: kv[1][0].lineno
+        ):
+            if (b, a) in self.edges and (b, a) not in seen:
+                seen.add((a, b))
+                other_node, other_fn = self.edges[(b, a)]
+                self._emit(
+                    LOCK_ORDER, node,
+                    f"{a.split('::')[-1]} -> {b.split('::')[-1]} here "
+                    f"({fn}) but {b.split('::')[-1]} -> "
+                    f"{a.split('::')[-1]} at line {other_node.lineno} "
+                    f"({other_fn}) — AB/BA deadlock under concurrent "
+                    "callers",
+                )
+
+    # ------------------------------------------------------------- run
+    def run(self) -> List[Finding]:
+        self._collect_locks()
+        self._collect_fns()
+        self._close_summaries()
+        for s in self.fns.values():
+            self._scan_fn(s)
+        self._check_unlocked_writes()
+        self._check_lock_order()
+        # dedupe (nested walk can visit a call twice through With items)
+        uniq: Dict[Tuple[str, int, int, str], Finding] = {}
+        for f in self.findings:
+            uniq.setdefault((f.rule, f.line, f.col, f.message), f)
+        return sorted(uniq.values(), key=lambda f: (f.path, f.line, f.col))
+
+
+# ----------------------------------------------------------------------
+# public API (mirrors lint.py)
+def concurrency_lint_paths(paths: Sequence[Path]) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        src = p.read_text()
+        tree = ast.parse(src, filename=str(p))
+        findings.extend(_ConcurrencyLinter(tree, src, str(p)).run())
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col))
+
+
+def concurrency_lint_package(pkg_root: Optional[str] = None,
+                             exclude=("analysis",)) -> List[Finding]:
+    """Concurrency-lint every module of the package (root resolution
+    and exclusion shared with lint.lint_package via
+    iter_package_modules — the two AST passes always scan the same
+    file set)."""
+    from .lint import iter_package_modules
+
+    files, _root = iter_package_modules(pkg_root, exclude)
+    return concurrency_lint_paths(files)
+
+
+def concurrency_lint_source(src: str, name: str = "fixture"
+                            ) -> List[Finding]:
+    """Lint a single in-memory module (test fixtures)."""
+    tree = ast.parse(src, filename=name)
+    return _ConcurrencyLinter(tree, src, name).run()

@@ -5,8 +5,9 @@ the quantized reduce-scatter wire (no data, no compile — jaxpr
 construction only, a couple of seconds on CPU) and asserts contracts
 that every perf/correctness regression so far would have tripped:
 
-- the int32 quantized wire: `reduce_scatter` present, every wire
-  operand integer-typed (no f32/f64 widening of the histogram wire);
+- the quantized wire: `reduce_scatter` present, every wire operand
+  exactly `QUANT_WIRE_DTYPE` (int32 today; ROADMAP 3a's int16 flip is
+  that one constant + a cost_audit wire-bytes budget refresh);
 - the overflow gate (ADVICE r5, histogram.rs_exact_ok): past the
   2^31 global / 2^24 per-shard exactness bounds the wire must VANISH
   and the f32 psum fallback take over;
@@ -66,50 +67,68 @@ class AuditResult(NamedTuple):
 
     def format(self) -> str:
         head = "PASS" if self.ok else "FAIL"
-        lines = [f"[{head}] {self.name} ({self.eqn_count} eqns)"]
+        size = f" ({self.eqn_count} eqns)" if self.eqn_count else ""
+        lines = [f"[{head}] {self.name}{size}"]
         for c in self.contracts:
             mark = "ok " if c.ok else "XX "
             lines.append(f"    {mark}{c.name}: {c.detail}")
         return "\n".join(lines)
 
 
-def _jaxpr_types():
-    """(ClosedJaxpr, Jaxpr) across jax versions: jax.core on 0.4.x,
+def _core_modules():
+    """jax core module candidates across versions: jax.core on 0.4.x,
     jax.extend.core where the old aliases were removed."""
     import jax
 
-    for mod in (getattr(jax, "core", None),
-                getattr(getattr(jax, "extend", None), "core", None)):
-        if mod is not None and hasattr(mod, "ClosedJaxpr"):
+    return [
+        mod for mod in (getattr(jax, "core", None),
+                        getattr(getattr(jax, "extend", None), "core", None))
+        if mod is not None
+    ]
+
+
+def _jaxpr_types():
+    """(ClosedJaxpr, Jaxpr) across jax versions."""
+    for mod in _core_modules():
+        if hasattr(mod, "ClosedJaxpr"):
             return mod.ClosedJaxpr, mod.Jaxpr
     raise RuntimeError("cannot locate jax ClosedJaxpr/Jaxpr types")
 
 
-def summarize(closed) -> JaxprSummary:
-    """Flatten a ClosedJaxpr (recursing into call/control-flow/pallas
-    sub-jaxprs) into the primitive/dtype statistics contracts read."""
+def iter_eqns(closed):
+    """Every equation of a ClosedJaxpr, recursing into call/
+    control-flow/pallas sub-jaxprs discovered through eqn params. The
+    ONE flattening walker — summarize() here and cost_audit's wire
+    accounting both consume it, so sub-jaxpr discovery cannot drift
+    between the structural and the byte-accounting views."""
     ClosedJaxpr, Jaxpr = _jaxpr_types()
-    prims: Counter = Counter()
-    dtypes: set = set()
-    wire: List[str] = []
-
-    def walk(jaxpr) -> None:
+    stack = [closed.jaxpr]
+    while stack:
+        jaxpr = stack.pop()
         for eqn in jaxpr.eqns:
-            prims[eqn.primitive.name] += 1
-            for v in list(eqn.invars) + list(eqn.outvars):
-                dt = getattr(getattr(v, "aval", None), "dtype", None)
-                if dt is not None:
-                    dtypes.add(str(dt))
-            if eqn.primitive.name == "reduce_scatter":
-                wire.append(str(eqn.invars[0].aval.dtype))
+            yield eqn
             for p in eqn.params.values():
                 for sub in (p if isinstance(p, (list, tuple)) else [p]):
                     if isinstance(sub, ClosedJaxpr):
-                        walk(sub.jaxpr)
+                        stack.append(sub.jaxpr)
                     elif isinstance(sub, Jaxpr):
-                        walk(sub)
+                        stack.append(sub)
 
-    walk(closed.jaxpr)
+
+def summarize(closed) -> JaxprSummary:
+    """Flatten a ClosedJaxpr into the primitive/dtype statistics the
+    contracts read."""
+    prims: Counter = Counter()
+    dtypes: set = set()
+    wire: List[str] = []
+    for eqn in iter_eqns(closed):
+        prims[eqn.primitive.name] += 1
+        for v in list(eqn.invars) + list(eqn.outvars):
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None:
+                dtypes.add(str(dt))
+        if eqn.primitive.name == "reduce_scatter":
+            wire.append(str(eqn.invars[0].aval.dtype))
     return JaxprSummary(
         dict(prims), sum(prims.values()), frozenset(dtypes), tuple(wire)
     )
@@ -140,15 +159,19 @@ def lacks_prim(name: str, why: str = "") -> ContractFn:
     return check
 
 
-def wire_int32() -> ContractFn:
-    """Every reduce_scatter operand is integer-typed: the quantized
-    histogram wire must never widen to f32/f64 before the collective."""
+def wire_dtype(dtype: str) -> ContractFn:
+    """Every reduce_scatter operand has exactly this dtype: the
+    quantized histogram wire must never widen (f32/f64 would double the
+    ICI/DCN payload) NOR silently narrow without the budget flip. The
+    expected dtype is `QUANT_WIRE_DTYPE` below — ROADMAP 3a's int16
+    wire lands by flipping that one constant and refreshing the
+    wire-bytes budget (cost_audit.py)."""
     def check(s: JaxprSummary) -> Contract:
-        bad = [d for d in s.wire_dtypes if not d.startswith(("int", "uint"))]
+        bad = [d for d in s.wire_dtypes if d != dtype]
         return Contract(
-            "wire_int32", not bad,
+            f"wire_{dtype}", not bad,
             f"wire dtypes {list(s.wire_dtypes)}"
-            + (f" — non-integer: {bad}" if bad else ""),
+            + (f" — expected {dtype}, got: {bad}" if bad else ""),
         )
     return check
 
@@ -353,7 +376,20 @@ class _Entry(NamedTuple):
     builder: Callable[[], Any]
     contracts: Callable[[Optional[int]], List[ContractFn]]
     doc: str
+    # expected collective wire payload dtype (None: entry has no
+    # quantized histogram wire). The one-line flip for ROADMAP 3a.
+    wire_dtype: Optional[str] = None
+    # entry contains pallas kernels: the cost auditor must trace it
+    # under the pallas interpreter to compile on the CPU backend
+    pallas_interpret: bool = False
 
+
+# the quantized data-parallel histogram wire dtype (reference halves
+# socket bytes with int16/int32 packing, include/LightGBM/bin.h:63-81;
+# our wire is int32 today — ROADMAP 3a flips this to int16, then
+# `python -m lightgbm_tpu.analysis --refresh-budgets` proves the
+# wire-bytes halving and pins it)
+QUANT_WIRE_DTYPE = "int32"
 
 # levels=16, 2048 local rows: 2048*8*16 = 262k < 2^31 and 2048*16 =
 # 32k < 2^24 — the rs wire must engage
@@ -367,14 +403,15 @@ ENTRIES: Dict[str, _Entry] = {
         lambda: _trace_rounds_dp(**_RS_OK),
         lambda budget: [
             has_prim("reduce_scatter",
-                     "the int32 histogram wire (bin.h:63-81)"),
-            wire_int32(),
+                     "the quantized histogram wire (bin.h:63-81)"),
+            wire_dtype(QUANT_WIRE_DTYPE),
             no_host_callbacks(),
             no_f64(),
             within_budget(budget),
         ],
         "quantized data-parallel grower inside the exactness bounds: "
-        "int32 reduce-scatter wire end to end",
+        f"{QUANT_WIRE_DTYPE} reduce-scatter wire end to end",
+        wire_dtype=QUANT_WIRE_DTYPE,
     ),
     "rounds_quant_rs_overflow": _Entry(
         lambda: _trace_rounds_dp(**_RS_OVERFLOW),
@@ -407,6 +444,7 @@ ENTRIES: Dict[str, _Entry] = {
             within_budget(budget),
         ],
         "fused partition+histogram kernel (pallas_hist._round_kernel)",
+        pallas_interpret=True,
     ),
     "serving_forest": _Entry(
         _trace_serving_forest,
@@ -488,6 +526,43 @@ def audit_fold_attrs() -> AuditResult:
 
 
 # ------------------------------------------------------------------ runner
+# entry traces are pure functions of checked-in shapes, and the strict
+# gate reads each one at least twice (jaxpr pass + cost pass, several
+# seconds per rounds trace) — memoize per (entry, interpret-mode)
+_CLOSED_CACHE: Dict[Any, Any] = {}
+
+
+def build_entry(name: str, pallas_interpret: bool = False):
+    """Entry ClosedJaxpr, memoized. With pallas_interpret the trace
+    runs under the pallas interpreter (histogram._interpret_pallas
+    reads the env var at trace time) so XLA:CPU can later compile it —
+    the cost auditor's path for pallas entries. The env var is forced
+    BOTH ways: an ambient LGBM_TPU_PALLAS_INTERPRET=1 (the pallas
+    debugging knob) must not leak an interpreted trace into the
+    non-interpreted budget comparison."""
+    import os
+
+    key = (name, bool(pallas_interpret))
+    if key in _CLOSED_CACHE:
+        return _CLOSED_CACHE[key]
+    entry = ENTRIES[name]
+    env_key = "LGBM_TPU_PALLAS_INTERPRET"
+    old = os.environ.get(env_key)
+    if pallas_interpret:
+        os.environ[env_key] = "1"
+    else:
+        os.environ.pop(env_key, None)
+    try:
+        closed = entry.builder()
+    finally:
+        if old is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = old
+    _CLOSED_CACHE[key] = closed
+    return closed
+
+
 def load_budgets() -> Dict[str, int]:
     if _BUDGET_PATH.exists():
         return {
@@ -515,7 +590,7 @@ def run_audits(names: Optional[Sequence[str]] = None,
     for name, entry in ENTRIES.items():
         if names is not None and name not in names:
             continue
-        closed = entry.builder()
+        closed = build_entry(name)
         s = summarize(closed)
         if update_budget:
             new_budgets[name] = int(math.ceil(s.eqn_count * _BUDGET_HEADROOM))

@@ -136,6 +136,28 @@ _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\[([a-zA-Z0-9_,\- ]+)\]")
 _ALLOW_FILE_RE = re.compile(r"#\s*lint:\s*allow-file\[([a-zA-Z0-9_,\- ]+)\]")
 
 
+def scan_allow_comments(src: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """`# lint: allow[rule]` / `# lint: allow-file[rule]` markers of a
+    source text: ({line: rule ids}, file-wide rule ids). Shared by this
+    linter and concurrency_lint.py so suppression syntax stays ONE
+    thing."""
+    allow_lines: Dict[int, Set[str]] = {}
+    allow_file: Set[str] = set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _ALLOW_RE.search(line)
+        if m:
+            allow_lines[i] = {
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            }
+        if i <= 10:
+            m = _ALLOW_FILE_RE.search(line)
+            if m:
+                allow_file |= {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+    return allow_lines, allow_file
+
+
 def _dotted(node: ast.AST) -> Optional[str]:
     """'a.b.c' for Name/Attribute chains, else None."""
     parts: List[str] = []
@@ -179,18 +201,7 @@ class _ModuleInfo:
         self._scan_top(tree)
 
     def _scan_comments(self, src: str) -> None:
-        for i, line in enumerate(src.splitlines(), start=1):
-            m = _ALLOW_RE.search(line)
-            if m:
-                self.allow_lines[i] = {
-                    r.strip() for r in m.group(1).split(",") if r.strip()
-                }
-            if i <= 10:
-                m = _ALLOW_FILE_RE.search(line)
-                if m:
-                    self.allow_file |= {
-                        r.strip() for r in m.group(1).split(",") if r.strip()
-                    }
+        self.allow_lines, self.allow_file = scan_allow_comments(src)
 
     def _scan_top(self, tree: ast.Module) -> None:
         for node in ast.walk(tree):
@@ -892,13 +903,16 @@ def lint_paths(paths: Sequence[Path], pkg_root: Path) -> List[Finding]:
     return _Linter(modules).run()
 
 
-def lint_package(pkg_root: Optional[str] = None,
-                 exclude=("analysis",)) -> List[Finding]:
-    """Lint every module of the package; `exclude` names subpackage or
-    module stems skipped (the analyzers themselves, by default). With
-    no pkg_root the INSTALLED lightgbm_tpu package is located — never
-    a CWD-relative guess, which would lint nothing from another
-    directory and report a vacuously clean result."""
+def iter_package_modules(pkg_root: Optional[str] = None,
+                         exclude=("analysis",)) -> Tuple[List[Path], Path]:
+    """(module files, package root) for a package-wide lint; `exclude`
+    names subpackage or module stems skipped (the analyzers
+    themselves, by default). With no pkg_root the INSTALLED
+    lightgbm_tpu package is located — never a CWD-relative guess,
+    which would lint nothing from another directory and report a
+    vacuously clean result. Shared by this linter and
+    concurrency_lint.py so the two --strict AST passes can never scan
+    different file sets."""
     if pkg_root is None:
         import lightgbm_tpu
 
@@ -915,6 +929,14 @@ def lint_package(pkg_root: Optional[str] = None,
             f"no Python modules under {root} — wrong pkg_root? a clean "
             "lint over zero files would be meaningless"
         )
+    return files, root
+
+
+def lint_package(pkg_root: Optional[str] = None,
+                 exclude=("analysis",)) -> List[Finding]:
+    """Lint every module of the package (see iter_package_modules for
+    root resolution and exclusion semantics)."""
+    files, root = iter_package_modules(pkg_root, exclude)
     return lint_paths(files, root)
 
 
@@ -927,13 +949,14 @@ def lint_source(src: str, name: str = "fixture",
 
 
 def format_findings(findings: Sequence[Finding],
-                    show_suppressed: bool = False) -> str:
+                    show_suppressed: bool = False,
+                    label: str = "lint") -> str:
     lines = [
         f.format() for f in findings if show_suppressed or not f.suppressed
     ]
     active = sum(1 for f in findings if not f.suppressed)
     sup = len(findings) - active
     lines.append(
-        f"lint: {active} violation(s), {sup} suppressed"
+        f"{label}: {active} violation(s), {sup} suppressed"
     )
     return "\n".join(lines)

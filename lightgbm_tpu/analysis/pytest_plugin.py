@@ -8,6 +8,11 @@ conftest. The in-repo tests import these fixtures from conftest.py.
 - `retrace_guard`: factory for the jit-cache-miss guard
   (analysis/retrace.py), with `jax.checking_leaks` opt-in.
 - `jaxpr_audit`: run named invariant audits inline and assert green.
+- `cost_audit`: run named cost/memory/wire-bytes audits inline and
+  assert green (compiles the entries on the CPU backend).
+- `concurrency_lint`: lint source text (or the installed package) with
+  the serving lock-discipline rules and assert no unsuppressed
+  findings.
 """
 
 from __future__ import annotations
@@ -32,5 +37,39 @@ def jaxpr_audit():
         bad = [r.format() for r in results if not r.ok]
         assert not bad, "\n".join(bad)
         return results
+
+    return run
+
+
+@pytest.fixture
+def cost_audit():
+    """fixture(names=None) -> list[AuditResult], asserting all green."""
+    from .cost_audit import run_cost_audits
+
+    def run(names=None):
+        results = run_cost_audits(names=names)
+        bad = [r.format() for r in results if not r.ok]
+        assert not bad, "\n".join(bad)
+        return results
+
+    return run
+
+
+@pytest.fixture
+def concurrency_lint():
+    """fixture(src=None) -> findings; None lints the installed package.
+    Asserts no unsuppressed findings either way."""
+    from .concurrency_lint import (
+        concurrency_lint_package,
+        concurrency_lint_source,
+    )
+    from .lint import format_findings
+
+    def run(src=None):
+        findings = (concurrency_lint_package() if src is None
+                    else concurrency_lint_source(src))
+        bad = [f for f in findings if not f.suppressed]
+        assert not bad, format_findings(bad, label="concurrency")
+        return findings
 
     return run

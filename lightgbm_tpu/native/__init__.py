@@ -26,9 +26,18 @@ _tried = False
 
 
 def _build() -> bool:
+    """Compile fastparse to a tmp file and atomically rename into
+    place. The rename makes concurrent builders safe WITHOUT a lock:
+    each builder — thread or process — writes its own tmp .so (pid +
+    thread id in the name) and os.replace is atomic, so a reader only
+    ever sees a complete library — get_lib deliberately does not hold
+    the module lock across this (the concurrency linter's
+    blocking-under-lock rule: a 180 s g++ run under `_lock` would
+    stall every thread touching the parser)."""
+    tmp = f"{_LIB}.build.{os.getpid()}.{threading.get_ident()}"
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        _SRC, "-o", _LIB,
+        _SRC, "-o", tmp,
     ]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
@@ -40,43 +49,63 @@ def _build() -> bool:
                 f"parsers): {r.stderr.strip()[-300:]}"
             )
             return False
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.TimeoutExpired):
         return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _load_or_build() -> Optional[ctypes.CDLL]:
+    """Build-if-stale + dlopen + bind, called OUTSIDE the module lock
+    (only the _lib/_tried state below is lock-guarded)."""
+    fresh = (
+        os.path.exists(_LIB)
+        and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+    )
+    if not fresh and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError:
+        return None
+    try:
+        _bind(lib)
+    except AttributeError:
+        # stale cached .so (newer mtime than the source but built
+        # from an older version, e.g. rsync -t / restored backup):
+        # rebuild once, then give up gracefully
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            _bind(lib)
+        except (OSError, AttributeError):
+            return None
+    return lib
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
     """The fastparse library, building it on first use; None if
-    unavailable (no g++ / build failure)."""
+    unavailable (no g++ / build failure). Concurrent first callers may
+    each run a build (atomic-rename safe); the winner's handle is the
+    one cached."""
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
-        _tried = True
-        fresh = (
-            os.path.exists(_LIB)
-            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
-        )
-        if not fresh and not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
-            return None
-        try:
-            _bind(lib)
-        except AttributeError:
-            # stale cached .so (newer mtime than the source but built
-            # from an older version, e.g. rsync -t / restored backup):
-            # rebuild once, then give up gracefully
-            if not _build():
-                return None
-            try:
-                lib = ctypes.CDLL(_LIB)
-                _bind(lib)
-            except (OSError, AttributeError):
-                return None
-        _lib = lib
+    lib = _load_or_build()
+    with _lock:
+        # prefer a non-None result: a transiently-failing concurrent
+        # loader must not cache None over another thread's good handle
+        if not _tried or (_lib is None and lib is not None):
+            _tried = True
+            _lib = lib
         return _lib
 
 

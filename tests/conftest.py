@@ -56,6 +56,8 @@ def rng():
 # in-repo suite and external suites (opt-in via
 # `pytest -p lightgbm_tpu.analysis.pytest_plugin`) share one definition
 from lightgbm_tpu.analysis.pytest_plugin import (  # noqa: E402,F401
+    concurrency_lint,
+    cost_audit,
     jaxpr_audit,
     retrace_guard,
 )

@@ -1,0 +1,233 @@
+"""Serving concurrency linter (analysis/concurrency_lint.py): every
+rule red-to-green on fixtures with known violations, the clean idioms
+stay clean, suppression syntax, and the real package at zero
+unsuppressed findings."""
+
+from pathlib import Path
+
+from lightgbm_tpu.analysis.concurrency_lint import (
+    CONCURRENCY_RULES,
+    concurrency_lint_package,
+    concurrency_lint_source,
+)
+from lightgbm_tpu.analysis.lint import RULES, format_findings
+
+REPO = Path(__file__).resolve().parents[1]
+
+_VIOLATIONS = '''
+import threading
+import time
+
+class Bad:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cond = threading.Condition()
+        self._items = []
+        self.count = 0
+
+    def locked_write(self):
+        with self._lock:
+            self._items.append(1)          # ownership: clean
+            self.count += 1                # ownership: clean
+
+    def unlocked_write(self):
+        self._items.append(2)              # unlocked-write
+        self.count = 5                     # unlocked-write
+
+    def ab(self):
+        with self._a:
+            with self._b:                  # lock-order (vs ba below;
+                pass                       # anchored at first edge)
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+
+    def relock(self):
+        with self._lock:
+            with self._lock:               # lock-order self-deadlock
+                pass
+
+    def fresh_lock(self):
+        lk = threading.Lock()              # per-call-lock
+        with lk:
+            return 1
+
+    def sleepy(self):
+        with self._lock:
+            time.sleep(1)                  # blocking-under-lock
+
+    def waits_ok(self):
+        with self._cond:
+            self._cond.wait(0.1)           # held condition: clean
+
+    def indirect(self):
+        with self._lock:
+            self.slow()                    # blocking-under-lock (call)
+
+    def slow(self):
+        time.sleep(2)
+
+    def join_ok(self):
+        with self._lock:
+            return ",".join(["a", "b"])    # str.join: clean
+
+    def join_bad(self, t):
+        with self._lock:
+            t.join()                       # blocking-under-lock
+'''
+
+
+def _rules_at(findings, rule):
+    return [f for f in findings if f.rule == rule and not f.suppressed]
+
+
+def test_each_rule_fires_on_fixture():
+    fs = concurrency_lint_source(_VIOLATIONS)
+    assert len(_rules_at(fs, "unlocked-write")) == 2
+    assert len(_rules_at(fs, "lock-order")) == 2  # inversion + relock
+    assert len(_rules_at(fs, "per-call-lock")) == 1
+    assert len(_rules_at(fs, "blocking-under-lock")) == 3
+    # every registered rule is exercised by this fixture
+    assert {f.rule for f in fs} == set(CONCURRENCY_RULES)
+
+
+def test_clean_idioms_stay_clean():
+    fs = concurrency_lint_source(_VIOLATIONS)
+    lines = {f.line for f in fs}
+    for i, txt in enumerate(_VIOLATIONS.splitlines(), start=1):
+        if "clean" in txt:
+            assert i not in lines, f"false positive on line {i}: {txt}"
+
+
+def test_reentrant_locks_not_flagged():
+    """RLock re-acquisition (direct and via a sibling-method call —
+    the ModelRegistry._entry pattern) is reentrant and clean; the
+    cross-method re-acquire of a PLAIN Lock is the deadlock."""
+    src = '''
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._plain = threading.Lock()
+
+    def _entry(self):
+        with self._lock:
+            return 1
+
+    def swap(self):
+        with self._lock:
+            return self._entry()           # RLock reentry: clean
+
+    def bad(self):
+        with self._plain:
+            return self._helper()          # deadlock via call
+
+    def _helper(self):
+        with self._plain:
+            return 2
+'''
+    fs = concurrency_lint_source(src)
+    assert len(fs) == 1 and fs[0].rule == "lock-order", \
+        format_findings(fs, label="concurrency")
+    assert "_plain" in fs[0].message
+
+
+def test_wait_in_helper_stays_exempt():
+    """The coalescing idiom refactored into a helper: a callee that
+    only waits on the condition the CALLER holds must stay clean
+    (wait releases the lock); a helper waiting on a DIFFERENT
+    condition still fires."""
+    src = '''
+import threading
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._other = threading.Condition()
+
+    def _linger(self):
+        self._cond.wait(0.002)
+
+    def _linger_other(self):
+        self._other.wait(0.002)
+
+    def drain(self):
+        with self._cond:
+            self._linger()                 # held-cond helper: clean
+
+    def cross(self):
+        with self._cond:
+            self._linger_other()           # blocking-under-lock
+'''
+    fs = concurrency_lint_source(src)
+    assert len(fs) == 1 and fs[0].rule == "blocking-under-lock", \
+        format_findings(fs, label="concurrency")
+    assert "_other" in fs[0].message
+    assert "blocking-under-lock" in src.splitlines()[fs[0].line - 1]
+
+
+def test_module_level_locks_tracked():
+    """Module-scope primitives (the native/ and timer.py pattern):
+    creation at module scope is clean; blocking under them — including
+    transitively through a module function — is flagged."""
+    src = '''
+import threading
+import subprocess
+
+_lock = threading.Lock()
+
+
+def _build():
+    subprocess.run(["g++"], timeout=180)
+
+
+def get_lib():
+    with _lock:
+        _build()                           # blocking-under-lock
+'''
+    fs = concurrency_lint_source(src)
+    assert len(fs) == 1 and fs[0].rule == "blocking-under-lock", \
+        format_findings(fs, label="concurrency")
+
+
+def test_suppression_comment_and_file_allow():
+    src = (
+        "import threading\n"
+        "import time\n"
+        "_lk = threading.Lock()\n"
+        "def f():\n"
+        "    with _lk:\n"
+        "        time.sleep(1)  # lint: allow[blocking-under-lock]\n"
+    )
+    fs = concurrency_lint_source(src)
+    assert len(fs) == 1 and fs[0].suppressed
+    src2 = "# lint: allow-file[blocking-under-lock]\n" + src.replace(
+        "  # lint: allow[blocking-under-lock]", ""
+    )
+    fs2 = concurrency_lint_source(src2)
+    assert len(fs2) == 1 and fs2[0].suppressed
+    # an unrelated rule id does NOT suppress
+    src3 = src.replace("blocking-under-lock", "per-call-lock")
+    fs3 = concurrency_lint_source(src3)
+    assert len(fs3) == 1 and not fs3[0].suppressed
+
+
+def test_rule_ids_disjoint_from_trace_linter():
+    """Both linters share one suppression namespace
+    (`# lint: allow[...]`), so rule ids must never collide."""
+    assert not set(RULES) & set(CONCURRENCY_RULES)
+
+
+def test_real_package_is_concurrency_clean():
+    """The acceptance bar: zero unsuppressed findings over the real
+    package — the serving layer's lock discipline is machine-checked
+    from here on (hazards get FIXED, like native.get_lib's
+    build-under-lock, or annotated where intentional)."""
+    fs = concurrency_lint_package(str(REPO / "lightgbm_tpu"))
+    bad = [f for f in fs if not f.suppressed]
+    assert not bad, "\n" + format_findings(bad, label="concurrency")

@@ -22,6 +22,27 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# Root cause of the long-standing "two pre-existing multihost failures"
+# (docs/DESIGN_DECISIONS.md "Multihost tests xfail ..."): some jaxlib
+# builds ship an XLA:CPU backend without cross-process collective
+# support, and jax.distributed workers then die inside
+# multihost_utils.process_allgather with exactly this error. That is an
+# environment limitation, not a regression — xfail on the signature so
+# the tier-1 gate stops carrying silent known-failures, while ANY other
+# worker failure (real lockstep/parity breaks) still fails loudly.
+# strict=False: on a jaxlib with Gloo CPU collectives the tests run
+# and must pass.
+_ENV_LIMIT = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _xfail_if_env_limited(outs) -> None:
+    if any(_ENV_LIMIT in out for out in outs):
+        pytest.xfail(
+            f"jaxlib CPU backend lacks cross-process collectives "
+            f"({_ENV_LIMIT!r}); see docs/DESIGN_DECISIONS.md"
+        )
+
+
 @pytest.mark.timeout(600)
 def test_two_process_data_parallel_lockstep():
     worker = Path(__file__).parent / "_multihost_worker.py"
@@ -44,6 +65,7 @@ def test_two_process_data_parallel_lockstep():
                 q.kill()
             pytest.fail("multihost worker timed out")
         outs.append(out)
+    _xfail_if_env_limited(outs)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {i} failed:\n{out[-2000:]}"
         assert "MULTIHOST_OK" in out, out[-2000:]
@@ -82,6 +104,7 @@ def test_two_process_full_train_api(tmp_path):
                 q.kill()
             pytest.fail("multihost train worker timed out")
         outs.append(out)
+    _xfail_if_env_limited(outs)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {i} failed:\n{out[-3000:]}"
         assert "MULTIHOST_TRAIN_OK" in out, out[-3000:]

@@ -145,36 +145,59 @@ def _mesh():
     return Mesh(np.asarray(jax.devices()), ("data",))
 
 
-def test_wire_int32_red_to_green():
-    """The dtype-widening contract: a deliberately f32-widened
-    reduce-scatter wire FAILS wire_int32; the int32 wire passes."""
+def _wire_fixture_jaxpr(widen: bool):
+    """An 8-shard psum_scatter wire, int32 or deliberately f32-widened
+    (shared with tests/test_cost_audit.py's wire-bytes tests)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    from lightgbm_tpu.analysis.jaxpr_audit import audit_jaxpr, wire_int32
     from lightgbm_tpu.parallel.data_parallel import shard_map_compat
 
     mesh = _mesh()
 
-    def make(widen):
-        def f(h):
-            wire = h.astype(jnp.float32) if widen else h.astype(jnp.int32)
-            return lax.psum_scatter(
-                wire, "data", scatter_dimension=0, tiled=True
-            )
-
-        sm = shard_map_compat(f, mesh=mesh, in_specs=(P(None, "data"),),
-                              out_specs=P("data"), check_vma=False)
-        return jax.make_jaxpr(sm)(
-            jax.ShapeDtypeStruct((16, 8), jnp.int32)
+    def f(h):
+        wire = h.astype(jnp.float32) if widen else h.astype(jnp.int32)
+        return lax.psum_scatter(
+            wire, "data", scatter_dimension=0, tiled=True
         )
 
-    red = audit_jaxpr(make(widen=True), [wire_int32()], "widened")
+    sm = shard_map_compat(f, mesh=mesh, in_specs=(P(None, "data"),),
+                          out_specs=P("data"), check_vma=False)
+    return jax.make_jaxpr(sm)(
+        jax.ShapeDtypeStruct((16, 8), jnp.int32)
+    )
+
+
+def test_wire_dtype_red_to_green():
+    """The dtype contract, parameterized (satellite of the int16 wire
+    plan): a deliberately f32-widened reduce-scatter wire FAILS
+    wire_dtype("int32"); the int32 wire passes — and the same int32
+    wire FAILS wire_dtype("int16"), which is what pins the ROADMAP 3a
+    flip once QUANT_WIRE_DTYPE changes."""
+    from lightgbm_tpu.analysis.jaxpr_audit import audit_jaxpr, wire_dtype
+
+    red = audit_jaxpr(_wire_fixture_jaxpr(widen=True),
+                      [wire_dtype("int32")], "widened")
     assert not red.ok, red.format()
-    green = audit_jaxpr(make(widen=False), [wire_int32()], "int32")
+    green = audit_jaxpr(_wire_fixture_jaxpr(widen=False),
+                        [wire_dtype("int32")], "int32")
     assert green.ok, green.format()
+    # after the int16 flip, today's int32 wire must read as a regression
+    not_halved = audit_jaxpr(_wire_fixture_jaxpr(widen=False),
+                             [wire_dtype("int16")], "int32-vs-int16")
+    assert not not_halved.ok, not_halved.format()
+
+
+def test_entry_table_records_quant_wire_dtype():
+    """The quant data-parallel entry declares its wire dtype in the
+    entry table (the cost auditor and the jaxpr contract both read
+    it), and it matches the module-level QUANT_WIRE_DTYPE flip point."""
+    from lightgbm_tpu.analysis.jaxpr_audit import ENTRIES, QUANT_WIRE_DTYPE
+
+    assert ENTRIES["rounds_quant_rs"].wire_dtype == QUANT_WIRE_DTYPE
+    assert QUANT_WIRE_DTYPE == "int32"  # today; ROADMAP 3a flips this
 
 
 def test_host_callback_contract_red_to_green():
@@ -364,12 +387,21 @@ def test_cli_strict_exits_zero():
 
 def test_strict_equivalent_in_process():
     """The same strict gate, in-process (runs in tier-1 even when the
-    subprocess variant is skipped as slow): zero unsuppressed lint
-    findings AND every jaxpr/fold-attr audit green."""
+    subprocess variant is skipped as slow): zero unsuppressed findings
+    from BOTH AST linters AND every jaxpr/fold-attr audit green. (The
+    cost/memory compiles are covered by their own tests in
+    test_cost_audit.py plus the slow CLI test above — recompiling all
+    five entries here would double tier-1's audit wall time.)"""
+    from lightgbm_tpu.analysis.concurrency_lint import (
+        concurrency_lint_package,
+    )
     from lightgbm_tpu.analysis.jaxpr_audit import run_audits
 
     fs = lint_package(str(REPO / "lightgbm_tpu"))
     assert not [f for f in fs if not f.suppressed], format_findings(fs)
+    cfs = concurrency_lint_package(str(REPO / "lightgbm_tpu"))
+    assert not [f for f in cfs if not f.suppressed], \
+        format_findings(cfs, label="concurrency")
     results = run_audits()
     bad = [r.format() for r in results if not r.ok]
     assert not bad, "\n".join(bad)

@@ -1,9 +1,20 @@
 #!/usr/bin/env bash
-# CI wiring for the trace-safety static analysis suite
-# (docs/STATIC_ANALYSIS.md). Strict mode: any unsuppressed lint
-# violation or failed jaxpr contract exits nonzero. The python entry
-# point forces jax onto a cpu 8-device mesh itself, so this is safe on
-# hosts whose ambient JAX_PLATFORMS points at real accelerators.
+# CI wiring for the static analysis suite (docs/STATIC_ANALYSIS.md):
+# trace-safety lint, serving concurrency lint, jaxpr invariant audits,
+# and the XLA cost/memory + collective wire-bytes audits — every pass
+# registered in analysis/passes.py. Strict mode: any unsuppressed
+# finding or failed contract/budget exits nonzero.
+#
+# Budget maintenance (run + review + commit the diff):
+#   tools/analysis.sh --update-budget     # jaxpr_budget.json
+#   tools/analysis.sh --refresh-budgets   # cost_budget.json (+ diff)
+#
+# The python entry point forces jax onto a cpu 8-device mesh itself, so
+# this is safe on hosts whose ambient JAX_PLATFORMS points at real
+# accelerators.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [[ "$*" == *--update-budget* || "$*" == *--refresh-budgets* ]]; then
+  exec python -m lightgbm_tpu.analysis "$@"
+fi
 exec python -m lightgbm_tpu.analysis --strict "$@"
