@@ -1,0 +1,167 @@
+"""Serving benchmark: QPS + latency percentiles for the scoring path.
+
+Prints ONE JSON line and writes it to BENCH_SERVE_rNN.json next to the
+training BENCH files, so serving performance is tracked
+round-over-round exactly like training throughput (ROADMAP item 4; the
+artifact always carries "qps", "p50_ms", "p99_ms").
+
+What it measures: a model is trained in-process on synthetic data,
+loaded into the serving ModelRegistry (bucket-padded dispatcher,
+warmed), then T threads fire R score requests of B rows each through
+``registry.predict`` — the same entry point both serving transports
+call — and per-request wall latencies are recorded. The registry's own
+LatencyStats ring (what ``/metrics`` and the stats op report) rides
+along in "stats" so the benchmark's numbers and the observability
+numbers can be cross-checked.
+
+Env overrides: BENCH_SERVE_TRAIN_ROWS, BENCH_SERVE_FEATURES,
+BENCH_SERVE_TREES, BENCH_SERVE_LEAVES, BENCH_SERVE_REQUESTS,
+BENCH_SERVE_BATCH, BENCH_SERVE_THREADS, BENCH_SERVE_QUEUE (also drive
+the microbatch-coalescing path), BENCH_SERVE_OUT (explicit output
+path), BENCH_SERVE_DIR (output directory, default: repo root).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+SCHEMA = "lightgbm-tpu/bench-serve/v1"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _pct(sorted_vals, p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(p * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def run_bench() -> dict:
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import ModelRegistry
+
+    train_rows = _env_int("BENCH_SERVE_TRAIN_ROWS", 20000)
+    n_feat = _env_int("BENCH_SERVE_FEATURES", 16)
+    n_trees = _env_int("BENCH_SERVE_TREES", 50)
+    n_leaves = _env_int("BENCH_SERVE_LEAVES", 31)
+    n_requests = _env_int("BENCH_SERVE_REQUESTS", 200)
+    batch = _env_int("BENCH_SERVE_BATCH", 64)
+    n_threads = _env_int("BENCH_SERVE_THREADS", 4)
+    use_queue = _env_int("BENCH_SERVE_QUEUE", 0) != 0
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(train_rows, n_feat).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    t0 = time.perf_counter()
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": n_leaves, "verbosity": -1},
+        ds, num_boost_round=n_trees,
+    )
+    train_s = time.perf_counter() - t0
+
+    registry = ModelRegistry(warmup=True)
+    registry.load("bench", bst, num_features=n_feat)
+
+    req = rs.randn(batch, n_feat).astype(np.float32)
+    # warmup outside the timed window (compiles + first-dispatch costs)
+    for _ in range(3):
+        registry.predict("bench", req, via_queue=use_queue)
+
+    latencies: list = []
+    lock = threading.Lock()
+    per_thread = max(n_requests // n_threads, 1)
+
+    def worker(seed: int) -> None:
+        wrs = np.random.RandomState(seed)
+        mine = []
+        for _ in range(per_thread):
+            rows = wrs.randn(batch, n_feat).astype(np.float32)
+            t = time.perf_counter()
+            registry.predict("bench", rows, via_queue=use_queue)
+            mine.append(time.perf_counter() - t)
+        with lock:
+            latencies.extend(mine)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(n_threads)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    done = len(latencies)
+    lat = sorted(latencies)
+    result = {
+        "schema": SCHEMA,
+        "metric": "serve_score_qps",
+        "qps": round(done / wall, 2) if wall > 0 else 0.0,
+        "p50_ms": round(1e3 * _pct(lat, 0.50), 4),
+        "p95_ms": round(1e3 * _pct(lat, 0.95), 4),
+        "p99_ms": round(1e3 * _pct(lat, 0.99), 4),
+        "mean_ms": round(1e3 * sum(lat) / len(lat), 4) if lat else 0.0,
+        "rows_per_sec": round(done * batch / wall, 1) if wall > 0 else 0.0,
+        "requests": done,
+        "batch_rows": batch,
+        "threads": n_threads,
+        "via_queue": use_queue,
+        "wall_s": round(wall, 3),
+        "model": {"trees": n_trees, "leaves": n_leaves,
+                  "features": n_feat, "train_rows": train_rows,
+                  "train_s": round(train_s, 2)},
+        "platform": jax.devices()[0].platform,
+        "device_count": jax.device_count(),
+        # the observability view of the same run (LatencyStats ring —
+        # what /metrics and the stats op report)
+        "stats": registry.stats().get("bench", {}),
+        "created_unix": time.time(),
+    }
+    return result
+
+
+def _next_out_path() -> str:
+    if os.environ.get("BENCH_SERVE_OUT"):
+        return os.environ["BENCH_SERVE_OUT"]
+    out_dir = os.environ.get("BENCH_SERVE_DIR", REPO)
+    rounds = [0]
+    for p in glob.glob(os.path.join(out_dir, "BENCH_SERVE_r*.json")):
+        m = re.search(r"BENCH_SERVE_r(\d+)\.json$", p)
+        if m:
+            rounds.append(int(m.group(1)))
+    return os.path.join(out_dir, f"BENCH_SERVE_r{max(rounds) + 1:02d}.json")
+
+
+def main() -> int:
+    result = run_bench()
+    out = _next_out_path()
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    result["artifact"] = out
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

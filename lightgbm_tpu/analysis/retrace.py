@@ -17,9 +17,13 @@ leak detection) can be enabled on the same guard.
     with retrace_guard(entry_points=[grow_tree_rounds], max_retraces=1):
         train_two_iterations()   # second iteration must reuse the trace
 
-Counting only happens while at least one guard is active, so the
-module-level listener (jax.monitoring has no unregister) costs nothing
-when unused.
+The listener counts for the whole process lifetime once installed (an
+int increment per trace/compile event — events fire per compilation,
+not per dispatch, so the idle cost is nil): guards read deltas, and
+`compile_counters()` exposes the running totals to the run manifest
+(obs/manifest.py). Install happens on the first guard or explicitly
+via `ensure_installed()` (cli.py does this when a manifest or profile
+is requested, so the counts cover the run from the start).
 """
 
 from __future__ import annotations
@@ -39,13 +43,10 @@ _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _lock = threading.Lock()
 _installed = False
-_active_guards = 0
 _counters: Dict[str, int] = {_TRACE_EVENT: 0, _COMPILE_EVENT: 0}
 
 
 def _listener(event: str, duration: float, **kwargs: Any) -> None:
-    if _active_guards <= 0:
-        return
     if event in _counters:
         with _lock:
             _counters[event] += 1
@@ -60,6 +61,24 @@ def _install() -> None:
 
         jax.monitoring.register_event_duration_secs_listener(_listener)
         _installed = True
+
+
+def ensure_installed() -> None:
+    """Start counting trace/compile events now (idempotent). Call early
+    when compile counts should cover the whole run — the manifest's
+    numbers only include events after installation."""
+    _install()
+
+
+def compile_counters() -> Dict[str, int]:
+    """Process-lifetime (since install) jaxpr-trace and backend-compile
+    event totals — the run manifest's compile section."""
+    with _lock:
+        return {
+            "jaxpr_traces": _counters[_TRACE_EVENT],
+            "backend_compiles": _counters[_COMPILE_EVENT],
+            "listener_installed": int(_installed),
+        }
 
 
 def _cache_size(fn: Any) -> Optional[int]:
@@ -111,7 +130,6 @@ def retrace_guard(
     """
     import jax
 
-    global _active_guards
     _install()
     report = GuardReport()
     names: List[str] = []
@@ -121,14 +139,12 @@ def retrace_guard(
         before_entry.append(_cache_size(fn))
     with _lock:
         before = dict(_counters)
-        _active_guards += 1
     try:
         ctx = jax.checking_leaks() if check_leaks else contextlib.nullcontext()
         with ctx:
             yield report
     finally:
         with _lock:
-            _active_guards -= 1
             report.traces = _counters[_TRACE_EVENT] - before[_TRACE_EVENT]
             report.compiles = (
                 _counters[_COMPILE_EVENT] - before[_COMPILE_EVENT]

@@ -32,7 +32,20 @@ from .learner.grower import TreeArrays, add_score
 from .metrics import Metric, create_metrics
 from .objectives import ObjectiveFunction, create_objective
 from .sample_strategy import create_sample_strategy
+from .timer import global_timer as _gt
 from .tree import Tree, traverse_tree_bins
+
+# canonical per-round host phase names (docs/OBSERVABILITY.md): the
+# eager loops (fast/sync) emit the three phases each iteration; the
+# fused loop — whose phases live inside one jit — emits one span per
+# dispatched step. obs.tracing records these as trace-event spans and
+# jax.profiler traces carry the same names via jax.named_scope.
+ROUND_PHASES = (
+    "round: gradients",
+    "round: grow",
+    "round: score update",
+)
+FUSED_ROUND_PHASE = "round: fused step"
 
 
 @dataclass
@@ -638,6 +651,25 @@ class GBDT:
             train_set.invalidate_device_cache()
 
     # ------------------------------------------------------------------
+    def _record_collective_wire(self, n_trees: int) -> None:
+        """Runtime collective wire accounting (docs/OBSERVABILITY.md):
+        count the estimated histogram-reduce payload for n_trees
+        freshly dispatched trees. Called only from host-side loop code
+        — never inside a trace, where it would tick once per compile
+        instead of once per dispatch."""
+        if self._dp is None or self._parallel_mode != "data":
+            return
+        fn = getattr(self._dp, "wire_bytes_per_tree", None)
+        if fn is None:
+            return
+        from .obs.metrics import record_collective_wire
+
+        record_collective_wire(
+            "data_parallel_grow",
+            fn(int(self.dev["bins"].shape[0])) * n_trees,
+        )
+
+    # ------------------------------------------------------------------
     def _renewal_setup(self):
         """(alpha, weights) for device percentile leaf renewal, or
         (None, None) when the objective doesn't renew. MAPE renews with
@@ -946,50 +978,59 @@ class GBDT:
         import jax.numpy as jnp
 
         K = self.num_class
-        grad_dev, hess_dev, init_scores = self._prepare_gradients(grad, hess)
+        with _gt.scope(ROUND_PHASES[0]):
+            grad_dev, hess_dev, init_scores = self._prepare_gradients(
+                grad, hess
+            )
         renew_alpha, renew_w = self._renewal_setup()
 
         one = jnp.float32(1.0)
         for k in range(K):
-            gk, hk = grad_dev[k], hess_dev[k]
-            mask, gk, hk = self.strategy.sample(
-                self.iter_, gk, hk, self.dev["valid"], self._label_dev
-            )
-            feat_mask = self._sample_features(k=k)
-            arrays, row_leaf = self._grow_maybe_quantized(
-                gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
-            )
-            ok = (arrays.num_nodes > 0).astype(jnp.float32)
-            if renew_alpha is not None:
-                arrays = self._apply_renewal(
-                    arrays, row_leaf, self.train.score[k], mask,
-                    renew_alpha, renew_w,
+            with _gt.scope(ROUND_PHASES[1]):
+                gk, hk = grad_dev[k], hess_dev[k]
+                mask, gk, hk = self.strategy.sample(
+                    self.iter_, gk, hk, self.dev["valid"], self._label_dev
                 )
-            lv = arrays.leaf_value * (self.shrinkage_rate * ok)
-            # score updates use the UNBIASED shrunk leaf values — the
-            # score already received init_scores[k] at BoostFromAverage
-            # (mirrors _train_one_iter_sync; adding the bias here too
-            # would double-count it)
-            self.train.score = self.train.score.at[k].set(
-                add_score(self.train.score[k], row_leaf, lv, one)
-            )
-            for vs in self.valids:
-                vdev = vs.dataset.device_arrays()
-                leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
-                vs.score = vs.score.at[k].set(
-                    add_score(vs.score[k], leaf, lv, one)
+                feat_mask = self._sample_features(k=k)
+                arrays, row_leaf = self._grow_maybe_quantized(
+                    gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
                 )
-            if abs(init_scores[k]) > 1e-15:
-                # AddBias (gbdt.cpp:424-426): only the STORED tree carries
-                # the boost-from-average bias
-                lv = lv + init_scores[k] * ok
-            arrays = arrays._replace(leaf_value=lv)
-            self.device_trees.append((arrays, None))
-            self._pending.append(arrays)
-            self._pending_meta.append((k, init_scores[k], self.shrinkage_rate))
-            # start the device->host copies now so _materialize is ~free
-            jax.tree.map(lambda a: a.copy_to_host_async(), arrays)
+                ok = (arrays.num_nodes > 0).astype(jnp.float32)
+                if renew_alpha is not None:
+                    arrays = self._apply_renewal(
+                        arrays, row_leaf, self.train.score[k], mask,
+                        renew_alpha, renew_w,
+                    )
+                lv = arrays.leaf_value * (self.shrinkage_rate * ok)
+            with _gt.scope(ROUND_PHASES[2]):
+                # score updates use the UNBIASED shrunk leaf values — the
+                # score already received init_scores[k] at BoostFromAverage
+                # (mirrors _train_one_iter_sync; adding the bias here too
+                # would double-count it)
+                self.train.score = self.train.score.at[k].set(
+                    add_score(self.train.score[k], row_leaf, lv, one)
+                )
+                for vs in self.valids:
+                    vdev = vs.dataset.device_arrays()
+                    leaf = self._traverse(arrays, vdev["bins"], vdev["nan_bin"], vdev.get("bundle"))
+                    vs.score = vs.score.at[k].set(
+                        add_score(vs.score[k], leaf, lv, one)
+                    )
+                if abs(init_scores[k]) > 1e-15:
+                    # AddBias (gbdt.cpp:424-426): only the STORED tree
+                    # carries the boost-from-average bias
+                    lv = lv + init_scores[k] * ok
+                arrays = arrays._replace(leaf_value=lv)
+                self.device_trees.append((arrays, None))
+                self._pending.append(arrays)
+                self._pending_meta.append(
+                    (k, init_scores[k], self.shrinkage_rate)
+                )
+                # start the device->host copies now so _materialize is
+                # ~free
+                jax.tree.map(lambda a: a.copy_to_host_async(), arrays)
 
+        self._record_collective_wire(K)
         self.iter_ += 1
         if self.iter_ % self._check_every == 0:
             self._materialize()
@@ -1001,23 +1042,30 @@ class GBDT:
     ) -> bool:
         import jax.numpy as jnp
 
+        import time as _time
+
         K = self.num_class
         ds = self.train_set
         self._materialize()  # keep model list ordering if modes ever mix
-        grad_dev, hess_dev, init_scores = self._prepare_gradients(grad, hess)
+        with _gt.scope(ROUND_PHASES[0]):
+            grad_dev, hess_dev, init_scores = self._prepare_gradients(
+                grad, hess
+            )
 
         should_continue = False
         for k in range(K):
-            gk, hk = grad_dev[k], hess_dev[k]
-            mask, gk, hk = self.strategy.sample(
-                self.iter_, gk, hk, self.dev["valid"], self._label_dev
-            )
-            feat_mask = self._sample_features(k=k)
-            arrays, row_leaf = self._grow_maybe_quantized(
-                gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
-            )
+            with _gt.scope(ROUND_PHASES[1]):
+                gk, hk = grad_dev[k], hess_dev[k]
+                mask, gk, hk = self.strategy.sample(
+                    self.iter_, gk, hk, self.dev["valid"], self._label_dev
+                )
+                feat_mask = self._sample_features(k=k)
+                arrays, row_leaf = self._grow_maybe_quantized(
+                    gk, hk, mask, feat_mask, self.dev["valid"], self.iter_, k
+                )
             if self.config.tpu_debug_check_split:
                 self._check_split(arrays, row_leaf, hk, mask)
+            t_up = _time.perf_counter()
             n_nodes = int(arrays.num_nodes)
             if n_nodes > 0:
                 should_continue = True
@@ -1114,6 +1162,8 @@ class GBDT:
                 t.leaf_value = np.array([bias], np.float64)
                 self.models.append(t)
                 self.device_trees.append((arrays, None))
+            _gt.add(ROUND_PHASES[2], _time.perf_counter() - t_up,
+                    start=t_up)
 
         if not should_continue:
             log.warning(
@@ -1124,6 +1174,7 @@ class GBDT:
                     self.models.pop()
                     self.device_trees.pop()
             return True
+        self._record_collective_wire(K)
         self.iter_ += 1
         return False
 
@@ -1430,9 +1481,13 @@ class GBDT:
     def fused_dispatch(self, n: int) -> None:
         """Dispatch n fused iterations without any host synchronization."""
         for _ in range(n):
-            self._fstate, trees, eval_row = self._f_step(
-                self._fstate, self._f_data
-            )
+            # per-round span: covers only the async DISPATCH (device
+            # time lands in "fused collect"); the in-jit phases show up
+            # in jax.profiler traces under their named_scope names
+            with _gt.scope(FUSED_ROUND_PHASE):
+                self._fstate, trees, eval_row = self._f_step(
+                    self._fstate, self._f_data
+                )
             for k, arrays in enumerate(trees):
                 self.device_trees.append((arrays, None))
                 self._pending.append(arrays)
@@ -1442,6 +1497,7 @@ class GBDT:
                 )
             self._f_evals.append(eval_row)
             self.iter_ += 1
+        self._record_collective_wire(n * self.num_class)
         # keep canonical score handles current (no sync; handle reassign)
         self.train.score = self._fstate["score"]
         for vs, s in zip(self.valids, self._fstate["vscores"]):

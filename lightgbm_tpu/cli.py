@@ -295,7 +295,8 @@ def _task_serve(params: Dict[str, str]) -> None:
     model_path = params.get("input_model", "LightGBM_model.txt")
     if not Path(model_path).exists():
         log.fatal(f"input model {model_path} does not exist")
-    prev_logger = (log._logger, log._info_method, log._warning_method)
+    prev_logger = (log._logger, log._info_method, log._warning_method,
+                   log._debug_method)
     if cfg.serve_port == 0:
         # stdio mode: the protocol owns stdout — framework logs move to
         # stderr BEFORE anything (registry load, mesh setup) can emit,
@@ -333,7 +334,8 @@ def _task_serve(params: Dict[str, str]) -> None:
         # main() must not append its own line after the logger restore
         log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
     finally:
-        log._logger, log._info_method, log._warning_method = prev_logger
+        (log._logger, log._info_method, log._warning_method,
+         log._debug_method) = prev_logger
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -357,24 +359,114 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 1
     task = params.get("task", "train")
+    # ---- observability hooks (docs/OBSERVABILITY.md): runtime phase
+    # timing, jax.profiler + span capture, and the run manifest
+    def _truthy(v: Any) -> bool:
+        return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+    if _truthy(params.get("timetag", "")):
+        from .timer import enable_timetag
+
+        enable_timetag()
+    profile_dir = str(params.get("profile_dir", "")).strip()
+    manifest_path = str(
+        params.get("run_manifest", params.get("manifest_file", ""))
+    ).strip()
+    rec = None
+    if profile_dir or manifest_path:
+        # start compile-event counting now so the manifest's numbers
+        # cover the whole run
+        from .analysis.retrace import ensure_installed
+
+        ensure_installed()
+    if profile_dir:
+        import jax
+
+        from .obs import tracing
+
+        os.makedirs(profile_dir, exist_ok=True)
+        rec = tracing.start_tracing()
+        try:
+            jax.profiler.start_trace(profile_dir)
+        except Exception as e:  # noqa: BLE001 — span capture still works
+            log.warning(f"jax.profiler trace capture unavailable: {e}")
     t0 = time.time()
-    if task == "train":
-        _task_train(params)
-    elif task in ("predict", "prediction", "test"):
-        _task_predict(params)
-    elif task == "save_binary":
-        _task_save_binary(params)
-    elif task == "convert_model":
-        _task_convert_model(params)
-    elif task in ("refit", "refit_tree"):
-        _task_refit(params)
-    elif task == "serve":
-        _task_serve(params)  # logs its own protocol-safe summary
+    try:
+        if task == "train":
+            _task_train(params)
+        elif task in ("predict", "prediction", "test"):
+            _task_predict(params)
+        elif task == "save_binary":
+            _task_save_binary(params)
+        elif task == "convert_model":
+            _task_convert_model(params)
+        elif task in ("refit", "refit_tree"):
+            _task_refit(params)
+        elif task == "serve":
+            _task_serve(params)  # logs its own protocol-safe summary
+            return 0
+        else:
+            log.fatal(f"Unknown task {task}")
+        log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
         return 0
-    else:
-        log.fatal(f"Unknown task {task}")
-    log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
-    return 0
+    finally:
+        # export failures must never mask the task's own error; and
+        # after task=serve the stdio protocol has owned stdout to EOF —
+        # export log lines go to stderr so a strict JSONL consumer
+        # never sees a non-JSON line on the response stream
+        prev_logger = None
+        if task == "serve" and (profile_dir or manifest_path):
+            prev_logger = (log._logger, log._info_method,
+                           log._warning_method, log._debug_method)
+
+            class _ExportStderrLogger:
+                @staticmethod
+                def info(msg: str) -> None:
+                    print(msg, file=sys.stderr, flush=True)
+
+                warning = info
+
+            log.register_logger(_ExportStderrLogger)
+        try:
+            if profile_dir:
+                import jax
+
+                from .obs import tracing
+
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 — trace may not have started
+                    pass
+                tracing.stop_tracing()
+                if rec is not None:
+                    try:
+                        rec.write_chrome(
+                            os.path.join(profile_dir, "trace_events.json")
+                        )
+                        rec.write_jsonl(
+                            os.path.join(profile_dir, "trace_events.jsonl")
+                        )
+                    except OSError as e:
+                        log.warning(f"trace export failed: {e}")
+            if profile_dir or manifest_path:
+                try:
+                    from .config import Config
+                    from .obs.manifest import write_manifest
+
+                    cfg = Config(dict(params))
+                    targets = [p for p in (
+                        manifest_path,
+                        os.path.join(profile_dir, "run_manifest.json")
+                        if profile_dir else "",
+                    ) if p]
+                    for p in targets:
+                        write_manifest(p, config=cfg, extra={"task": task})
+                except Exception as e:  # noqa: BLE001 — incl. config fatals
+                    log.warning(f"run manifest not written: {e}")
+        finally:
+            if prev_logger is not None:
+                (log._logger, log._info_method, log._warning_method,
+                 log._debug_method) = prev_logger
 
 
 if __name__ == "__main__":

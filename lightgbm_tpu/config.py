@@ -219,6 +219,17 @@ _PARAMS: Dict[str, _P] = {
     "serve_buckets": (DEFAULT_SERVE_BUCKETS, "list_int", (), None),
     "serve_warmup": (True, bool, (), None),  # precompile every bucket
     "serve_model_name": ("default", str, (), None),
+    # ---- observability (lightgbm_tpu/obs, docs/OBSERVABILITY.md) ----
+    # runtime switch for the phase timer (the env LIGHTGBM_TPU_TIMETAG
+    # analog of the reference's compile-time USE_TIMETAG) — no restart
+    # needed
+    "timetag": (False, bool, (), None),
+    # capture a jax.profiler trace + host span trace + run manifest
+    # into this directory (span names align via jax.named_scope)
+    "profile_dir": ("", str, (), None),
+    # write a run-manifest JSON (config/topology/compiles/wire bytes)
+    # to this path after the task finishes
+    "run_manifest": ("", str, ("manifest_file",), None),
 }
 
 # alias -> canonical name

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -38,6 +39,12 @@ def train(
     """Train a model (reference engine.py:109 lgb.train)."""
     params, num_boost_round = _resolve_num_boost_round(params, num_boost_round)
     cfg_probe = Config(params)
+    if cfg_probe.timetag:
+        # runtime USE_TIMETAG switch (docs/OBSERVABILITY.md): phase
+        # timing on without restarting the process
+        from .timer import enable_timetag
+
+        enable_timetag()
     if cfg_probe.objective == "none" and fobj is None:
         log.warning("Using custom objective requires fobj; objective=none trains nothing")
     # early stopping via params (engine.py behavior)
@@ -131,14 +138,20 @@ def train(
         chunk = gbdt._check_every
         done = 0
         stop = False
+        from .obs.metrics import record_training_round
         from .timer import global_timer as _gt
 
         while done < num_boost_round and not stop:
             n = min(chunk, num_boost_round - done)
+            t_chunk = time.perf_counter()
             with _gt.scope("fused dispatch"):
                 gbdt.fused_dispatch(n)
             with _gt.scope("fused collect (readback)"):
                 records = gbdt.fused_collect()
+            record_training_round(
+                len(records), len(records) * gbdt.num_class,
+                time.perf_counter() - t_chunk,
+            )
             for j, evals in enumerate(records):
                 i = done + j
                 evaluation_result_list = evals
@@ -168,10 +181,16 @@ def train(
                         evaluation_result_list = e.best_score
                 break
     else:
+        from .obs.metrics import record_training_round
+
         for i in range(num_boost_round):
             for cb in cb_before:
                 cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
+            t_iter = time.perf_counter()
             finished = booster.update(fobj=fobj)
+            record_training_round(
+                1, booster._gbdt.num_class, time.perf_counter() - t_iter
+            )
 
             evaluation_result_list = []
             if valid_contain_train:

@@ -34,24 +34,32 @@ def _build() -> bool:
     the module lock across this (the concurrency linter's
     blocking-under-lock rule: a 180 s g++ run under `_lock` would
     stall every thread touching the parser)."""
+    import time
+
+    from ..obs.metrics import record_native_build
+
     tmp = f"{_LIB}.build.{os.getpid()}.{threading.get_ident()}"
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
         _SRC, "-o", tmp,
     ]
+    t0 = time.perf_counter()
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
         if r.returncode != 0:
             from .. import log
 
+            record_native_build(time.perf_counter() - t0, ok=False)
             log.warning(
                 f"native fastparse build failed (falling back to numpy "
                 f"parsers): {r.stderr.strip()[-300:]}"
             )
             return False
         os.replace(tmp, _LIB)
+        record_native_build(time.perf_counter() - t0, ok=True)
         return True
     except (OSError, subprocess.TimeoutExpired):
+        record_native_build(time.perf_counter() - t0, ok=False)
         return False
     finally:
         if os.path.exists(tmp):

@@ -1,0 +1,51 @@
+"""Unified observability layer (docs/OBSERVABILITY.md).
+
+Three pieces, all host-side (never inside jit — the no-callback jaxpr
+contract in analysis/jaxpr_audit.py is re-audited over the
+instrumented entries):
+
+- ``metrics`` — a thread-safe **metrics registry** (counters / gauges /
+  histograms with labels) with Prometheus text exposition, served from
+  the serving HTTP transport's ``/metrics`` route;
+- ``tracing`` — **span tracing** layered on ``timer.Timer`` +
+  ``jax.named_scope``, exportable as Chrome trace-event JSON
+  (Perfetto) and a JSONL event log, name-aligned with ``jax.profiler``
+  traces captured via the ``profile_dir`` CLI param;
+- ``manifest`` — per-run **manifest JSON**: config, device topology,
+  compile counts (retrace guard), phase timings, metrics snapshot, and
+  runtime collective wire bytes vs the static ``cost_budget.json``
+  pins.
+"""
+
+from . import manifest, metrics, tracing
+from .manifest import build_manifest, write_manifest
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Sample,
+    default_registry,
+)
+
+# NOTE: tracing's context manager is reached as `tracing.tracing(...)`
+# — re-exporting the function here would shadow the submodule name.
+from .tracing import TraceRecorder, span, start_tracing, stop_tracing
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Sample",
+    "default_registry",
+    "TraceRecorder",
+    "span",
+    "start_tracing",
+    "stop_tracing",
+    "metrics",
+    "tracing",
+    "manifest",
+    "build_manifest",
+    "write_manifest",
+]

@@ -66,6 +66,8 @@ class DataParallelGrower:
         self.axis_name = axis_name
         n = int(mesh.devices.size)
         self.spec = spec._replace(axis_name=axis_name, axis_size=n)
+        # (num_features -> payload bytes per grown tree) memo
+        self._wire_est: dict = {}
         s = self.spec
         if (n > 1 and s.quant and not s.efb and not s.has_cat
                 and not s.cat_subset and not s.mono_mode
@@ -126,6 +128,25 @@ class DataParallelGrower:
             bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask, feat_mask,
             params, valid, bundle, rng_key, group_mat, cegb, forced, gh_scale,
         )
+
+    def wire_bytes_per_tree(self, num_features: int) -> int:
+        """Host-side estimate of the collective payload per grown tree:
+        one (channels, F, B) histogram reduce per split plus the root
+        sums, 4-byte lanes (f32 psum or int32 reduce-scatter) — the
+        RUNTIME twin of the static wire pins in
+        analysis/cost_budget.json (obs/manifest.py puts the two side by
+        side). Memoized per num_features. Boosting records this from
+        its HOST loops, never from traced code, so the counter ticks
+        per dispatched tree."""
+        if self.spec.axis_size <= 1:
+            return 0
+        F = int(num_features)
+        est = self._wire_est.get(F)
+        if est is None:
+            per_split = 3 * F * int(self.spec.num_bins) * 4
+            est = per_split * int(self.spec.num_leaves)
+            self._wire_est[F] = est
+        return est
 
     def shard_inputs(self, dev: dict) -> dict:
         """device_put the dataset arrays with the right shardings.

@@ -32,6 +32,11 @@ import numpy as np
 
 from .. import log
 from ..config import DEFAULT_SERVE_BUCKETS as DEFAULT_BUCKETS
+from ..obs.metrics import (
+    record_bucket_dispatch,
+    record_coalesce,
+    record_queue_depth,
+)
 from ..timer import latency_stats
 
 
@@ -55,6 +60,7 @@ class BucketDispatcher:
             )
         self.buckets: Tuple[int, ...] = tuple(aligned)
         self.forest = forest
+        self.name = name
         self._stats = latency_stats(name)
 
     # ------------------------------------------------------------------
@@ -98,6 +104,7 @@ class BucketDispatcher:
             chunk = X[pos: pos + top]
             rows = chunk.shape[0]
             b = self.bucket_for(rows)
+            record_bucket_dispatch(self.name, b, rows)
             if rows < b:
                 chunk = np.concatenate(
                     [chunk, np.zeros((b - rows, X.shape[1]), np.float32)]
@@ -187,7 +194,11 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._pending.append((X, fut))
+            depth = len(self._pending)
             self._cond.notify()
+        # gauge update outside the condition: the metrics registry has
+        # its own lock and must not nest under the queue's
+        record_queue_depth(self.dispatcher.name, depth)
         return fut
 
     def close(self) -> None:
@@ -222,6 +233,9 @@ class MicroBatcher:
                     X, fut = self._pending.pop(0)
                     batch.append((X, fut))
                     rows += X.shape[0]
+                depth = len(self._pending)
+            record_queue_depth(self.dispatcher.name, depth)
+            record_coalesce(self.dispatcher.name, len(batch), rows)
             try:
                 Xall = np.concatenate([x for x, _ in batch]) \
                     if len(batch) > 1 else batch[0][0]

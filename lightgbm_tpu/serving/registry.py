@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import log
+from ..obs.metrics import record_registry_event
 from .dispatch import DEFAULT_BUCKETS, BucketDispatcher
 from .forest import TensorForest
 
@@ -118,6 +119,7 @@ class ModelRegistry:
             versions.append(ModelVersion(v, booster, forest, dispatcher, src))
             if activate or name not in self._active:
                 self._active[name] = v
+        record_registry_event("load", name)
         log.info(f"serving registry: loaded {name!r} v{v} from {src}")
         return v
 
@@ -136,6 +138,7 @@ class ModelRegistry:
         with self._lock:
             mv = self._entry(name, version)
             self._active[name] = mv.version
+        record_registry_event("swap", name)
 
     def rollback(self, name: str) -> int:
         """Activate the newest version BELOW the active one."""
@@ -146,7 +149,9 @@ class ModelRegistry:
             if not older:
                 raise KeyError(f"model {name!r} has no version below {cur}")
             self._active[name] = max(older)
-            return self._active[name]
+            active = self._active[name]
+        record_registry_event("rollback", name)
+        return active
 
     def unload(self, name: str, version: Optional[int] = None) -> None:
         """Drop one version (or the whole name); the active version of
@@ -173,6 +178,8 @@ class ModelRegistry:
         for mv in dropped:  # outside the lock: close() joins the worker
             if mv.batcher is not None:
                 mv.batcher.close()
+        if dropped:
+            record_registry_event("unload", name)
 
     def models(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:

@@ -10,7 +10,9 @@ Two transports over ONE request vocabulary (docs/SERVING.md):
   ``POST /v1/<op>`` to the same handler (no new dependencies). Each
   request runs on its own thread; score requests carrying
   ``"queue": true`` additionally coalesce through the model's
-  MicroBatcher into shared padded device calls.
+  MicroBatcher into shared padded device calls. ``GET /metrics``
+  serves Prometheus text exposition from the obs metrics registry and
+  ``GET /healthz`` answers liveness probes (docs/OBSERVABILITY.md).
 
 Request ops:
   {"op": "score", "model": "m", "rows": [[...], ...],
@@ -33,11 +35,20 @@ from typing import Any, Dict, IO, Optional
 import numpy as np
 
 from .. import log
+from ..obs.metrics import default_registry, record_request_op
 from .registry import ModelRegistry
 
 
 def handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, Any]:
-    """One request dict -> one response dict (shared by both transports)."""
+    """One request dict -> one response dict (shared by both transports).
+    Every request counts into the obs metrics registry by op — the
+    serve-loop counter /metrics and the stats op both read."""
+    resp = _handle_request(registry, req)
+    record_request_op(str(req.get("op", "score")), bool(resp.get("ok")))
+    return resp
+
+
+def _handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, Any]:
     op = req.get("op", "score")
     try:
         if op == "ping":
@@ -114,7 +125,8 @@ class ScoringServer:
 def serve_http(registry: ModelRegistry, port: int,
                host: str = "127.0.0.1", block: bool = True):
     """HTTP server: POST /v1/<op> with the same JSON bodies ("op"
-    inferred from the path); GET /v1/models, /v1/stats, /healthz.
+    inferred from the path); GET /v1/models, /v1/stats, /healthz,
+    /metrics (Prometheus text exposition).
     port=0 binds an ephemeral port. With block=True (the task=serve
     mode) returns only when the process is interrupted; block=False
     returns the bound httpd immediately (serve it from your own
@@ -134,7 +146,27 @@ def serve_http(registry: ModelRegistry, port: int,
 
         def do_GET(self):  # noqa: N802 — http.server API
             if self.path in ("/healthz", "/health"):
-                self._reply({"ok": True})
+                # internal listing via the UNCOUNTED inner handler: a
+                # liveness probe must not inflate the op="models"
+                # protocol counter
+                with_models = _handle_request(registry, {"op": "models"})
+                self._reply({
+                    "ok": True,
+                    "models": sorted(with_models.get("models", {})),
+                })
+            elif self.path == "/metrics":
+                # Prometheus text exposition (docs/OBSERVABILITY.md):
+                # scrape-time samples from the same registry + latency
+                # rings the stats op reports
+                body = default_registry().render_prometheus().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif self.path == "/v1/models":
                 self._reply(handle_request(registry, {"op": "models"}))
             elif self.path == "/v1/stats":

@@ -12,8 +12,15 @@ paths). Scopes also enter `jax.profiler.TraceAnnotation`-compatible
 the same names.
 
 Enable summary-at-exit with env LIGHTGBM_TPU_TIMETAG=1 (the analog of
-the reference's compile-time USE_TIMETAG), or call
-`global_timer.print_summary()` directly.
+the reference's compile-time USE_TIMETAG), with the `timetag` config /
+CLI param, or at runtime via `global_timer.enable()` — unlike the
+reference's compile-time flag, timing can be turned on and off without
+restarting the process.
+
+While an obs.tracing recorder is active, every scope additionally
+records a Chrome trace-event span (the recorder installs itself here
+through `set_trace_sink`), so the phase table, the trace timeline, and
+jax.profiler annotations all carry the same names.
 """
 
 from __future__ import annotations
@@ -23,7 +30,41 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
+
+# active span sink: obs.tracing installs `(name, start_s, dur_s) ->
+# None` here while recording (module attribute, not a Timer field, so
+# one recorder observes every Timer instance)
+_trace_sink: Optional[Callable[[str, float, float], None]] = None
+
+
+def set_trace_sink(
+    sink: Optional[Callable[[str, float, float], None]]
+) -> None:
+    """Install (or clear, with None) the span recorder scopes report
+    to. Owned by obs.tracing; exposed here so timer stays a leaf
+    module with no obs import."""
+    global _trace_sink
+    _trace_sink = sink
+
+
+def _sync_devices() -> None:
+    """Barrier: wait for completion of all work dispatched so far on
+    EVERY local device (the old hack synced one op on the default
+    device only — a sharded computation's other shards kept running).
+    Each device executes its stream in order, so blocking on a tiny
+    computation enqueued per device flushes everything before it."""
+    import jax
+
+    try:
+        jax.effects_barrier()
+    except Exception:  # noqa: BLE001 — older jax without effects_barrier
+        pass
+    for d in jax.local_devices():
+        try:
+            (jax.device_put(0, d) + 0).block_until_ready()
+        except Exception:  # noqa: BLE001 — never break the timed path
+            continue
 
 
 class Timer:
@@ -33,13 +74,26 @@ class Timer:
         self._acc: Dict[str, float] = {}
         self._cnt: Dict[str, int] = {}
         self.enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+        self._summary_at_exit = self.enabled
+
+    def enable(self, summary_at_exit: bool = True) -> None:
+        """Turn timing on at runtime (config/CLI `timetag` hook); the
+        at-exit summary registers once."""
+        self.enabled = True
+        if summary_at_exit and not self._summary_at_exit:
+            self._summary_at_exit = True
+            atexit.register(self.print_summary)
+
+    def disable(self) -> None:
+        self.enabled = False
 
     @contextmanager
     def scope(self, name: str, block: bool = False) -> Iterator[None]:
-        """Time a region; with block=True waits for device completion
-        (jax.block_until_ready on nothing — a full device sync) before
-        stopping, so the region includes its dispatched work."""
-        if not self.enabled:
+        """Time a region; with block=True waits for completion of all
+        dispatched device work (every local device) before stopping
+        the clock, so the region includes its dispatched work."""
+        sink = _trace_sink
+        if not self.enabled and sink is None:
             yield
             return
         import jax
@@ -48,13 +102,27 @@ class Timer:
         with jax.named_scope(name.replace(" ", "_")):
             yield
         if block:
-            try:
-                (jax.device_put(0) + 0).block_until_ready()
-            except Exception:  # noqa: BLE001 — never break the timed path
-                pass
+            _sync_devices()
         dt = time.perf_counter() - t0
-        self._acc[name] = self._acc.get(name, 0.0) + dt
-        self._cnt[name] = self._cnt.get(name, 0) + 1
+        if self.enabled:
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._cnt[name] = self._cnt.get(name, 0) + 1
+        if sink is not None:
+            sink(name, t0, dt)
+
+    def add(self, name: str, seconds: float,
+            start: Optional[float] = None) -> None:
+        """Record an externally-timed region: accumulates like scope()
+        and reports to the active trace sink (`start` is the region's
+        time.perf_counter() start, for span placement)."""
+        if self.enabled:
+            self._acc[name] = self._acc.get(name, 0.0) + seconds
+            self._cnt[name] = self._cnt.get(name, 0) + 1
+        sink = _trace_sink
+        if sink is not None:
+            if start is None:
+                start = time.perf_counter() - seconds
+            sink(name, start, seconds)
 
     def summary(self) -> Dict[str, tuple]:
         return {
@@ -81,6 +149,12 @@ global_timer = Timer()
 
 if global_timer.enabled:
     atexit.register(global_timer.print_summary)
+
+
+def enable_timetag() -> None:
+    """Config/CLI hook (`timetag=true`): turn on the global phase timer
+    mid-process (engine.train and cli.main both route here)."""
+    global_timer.enable()
 
 
 class LatencyStats:
@@ -158,11 +232,20 @@ _latency_lock = threading.Lock()
 
 def latency_stats(name: str) -> LatencyStats:
     """Named process-global LatencyStats (one per serving entry point,
-    mirroring global_timer's named-scope registry)."""
+    mirroring global_timer's named-scope registry). Each named ring
+    registers itself on the obs metrics registry at creation, so
+    `/metrics` scrapes and `ModelRegistry.stats()` read the SAME
+    object — one source of truth for serving latency."""
     with _latency_lock:
-        if name not in _latency:
+        created = name not in _latency
+        if created:
             _latency[name] = LatencyStats()
-        return _latency[name]
+        stats = _latency[name]
+    if created:
+        from .obs.metrics import register_latency_collector
+
+        register_latency_collector(name, stats)
+    return stats
 
 
 def latency_summary() -> Dict[str, Dict[str, float]]:

@@ -1,0 +1,422 @@
+"""Observability layer (lightgbm_tpu/obs, docs/OBSERVABILITY.md):
+metrics registry + Prometheus exposition, trace-event export, run
+manifests, bench_serve artifact, and the no-callback re-audit."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import re
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import boosting, log
+from lightgbm_tpu.obs import tracing
+from lightgbm_tpu.obs.metrics import MetricsRegistry, default_registry
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _train(params, X, y, rounds=5):
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    p = {"verbosity": -1, **params}
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+# ----------------------------------------------------------------- metrics
+def test_registry_counter_gauge_histogram():
+    r = MetricsRegistry(enabled=True)
+    c = r.counter("c_total", "a counter", labels=("op",))
+    c.inc(op="score")
+    c.inc(2.5, op="score")
+    c.inc(op="load")
+    assert c.value(op="score") == 3.5
+    assert c.value(op="load") == 1.0
+    with pytest.raises(ValueError):
+        c.inc(-1, op="score")  # counters are monotone
+    with pytest.raises(ValueError):
+        c.inc(1, bad_label="x")  # undeclared label
+
+    g = r.gauge("g")
+    g.set(4.0)
+    g.inc()
+    g.dec(2.0)
+    assert g.value() == 3.0
+
+    h = r.histogram("h_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    s = h.state()
+    assert s["count"] == 3 and s["counts"] == [1, 2]
+    assert abs(s["sum"] - 5.55) < 1e-9
+
+    # re-registration returns the same object; mismatch raises
+    assert r.counter("c_total", labels=("op",)) is c
+    with pytest.raises(ValueError):
+        r.gauge("c_total")
+    with pytest.raises(ValueError):
+        r.counter("c_total", labels=("other",))
+
+
+def test_registry_disabled_is_noop_and_reset():
+    r = MetricsRegistry(enabled=False)
+    c = r.counter("c_total")
+    c.inc()
+    assert c.value() == 0.0
+    r.enable()
+    c.inc()
+    assert c.value() == 1.0
+    r.reset()
+    assert c.value() == 0.0
+
+
+_PROM_LINE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$'
+)
+
+
+def _parse_prom(text):
+    """Parse text exposition into {(name, frozenset(labels)): value},
+    asserting every non-comment line matches the format."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        assert m, f"invalid exposition line: {line!r}"
+        labels = frozenset(
+            re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                       m.group(2) or "")
+        )
+        out[(m.group(1), labels)] = float(m.group(3))
+    return out
+
+
+def test_metrics_endpoint_matches_registry_stats(rng):
+    """/metrics exposition parses, and the scraped serving-latency
+    values agree with ModelRegistry.stats() — one LatencyStats ring
+    behind both readers (the dedupe contract)."""
+    import urllib.request
+
+    from lightgbm_tpu.serving import ModelRegistry, serve_http
+
+    X = rng.randn(500, 4)
+    bst = _train({"objective": "regression", "num_leaves": 15},
+                 X, X[:, 0] + X[:, 1])
+    reg = ModelRegistry()
+    reg.load("obs", bst)
+    httpd = serve_http(reg, port=0, block=False)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        body = json.dumps({"rows": X[:32].tolist(), "model": "obs"}).encode()
+        req = urllib.request.Request(
+            base + "/v1/score", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        for _ in range(3):
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read())["ok"]
+
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["ok"] and "obs" in health["models"]
+
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            scraped = _parse_prom(r.read().decode())
+        stats = reg.stats()["obs"]
+
+        entry = frozenset({("entry", "serve:obs")})
+        assert scraped[("lgbmtpu_serve_requests_total", entry)] == \
+            stats["count"]
+        assert scraped[("lgbmtpu_serve_rows_total", entry)] == stats["rows"]
+        for stat in ("p50", "p95", "p99", "mean"):
+            key = ("lgbmtpu_serve_latency_ms",
+                   frozenset({("entry", "serve:obs"), ("stat", stat)}))
+            assert scraped[key] == pytest.approx(stats[f"{stat}_ms"])
+        # the serve-loop op counter rode the same scrape
+        score_ops = [
+            v for (name, labels), v in scraped.items()
+            if name == "lgbmtpu_serve_protocol_requests_total"
+            and ("op", "score") in labels
+        ]
+        assert score_ops and score_ops[0] >= 3
+        # bucket-ladder dispatch accounting is present for this entry
+        assert any(
+            name == "lgbmtpu_serve_bucket_dispatch_total"
+            and ("entry", "serve:obs") in labels
+            for (name, labels) in scraped
+        )
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_latency_stats_reset_and_shared_ring():
+    from lightgbm_tpu.timer import latency_stats
+
+    s = latency_stats("obs-reset-test")
+    s.observe(0.010, rows=8)
+    assert s.snapshot()["count"] == 1
+    s.reset()
+    snap = s.snapshot()
+    assert snap["count"] == 0 and snap["rows"] == 0 and snap["p99_ms"] == 0
+    # same name -> same object (the one-source-of-truth registry)
+    assert latency_stats("obs-reset-test") is s
+
+
+# ----------------------------------------------------------------- tracing
+def test_trace_export_fused_round_spans(rng, tmp_path):
+    """Chrome trace-event JSON loads and carries one fused-round span
+    per boosting iteration (the fused path's per-round phase)."""
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    path = tmp_path / "trace.json"
+    jsonl = tmp_path / "trace.jsonl"
+    with tracing.tracing(chrome_path=str(path),
+                         jsonl_path=str(jsonl)) as rec:
+        _train({"objective": "binary", "num_leaves": 7}, X, y, rounds=4)
+    data = json.loads(path.read_text())
+    assert "traceEvents" in data
+    spans = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+    for e in spans:
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert "pid" in e and "tid" in e and e["name"]
+    fused = [e for e in spans if e["name"] == boosting.FUSED_ROUND_PHASE]
+    assert len(fused) == 4
+    # the JSONL log carries the same events one-per-line
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert sum(1 for e in lines
+               if e.get("name") == boosting.FUSED_ROUND_PHASE) == 4
+    assert rec.events()  # recorder still readable after export
+
+
+def test_trace_eager_path_has_every_round_phase(rng):
+    """The eager (non-fused) training loop emits a span for EVERY
+    per-round phase: gradients, grow, score update."""
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    def cb(env):
+        return None
+
+    cb.before_iteration = True  # pre-iteration callbacks force non-fused
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    with tracing.tracing() as rec:
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1}, ds, num_boost_round=3, callbacks=[cb])
+    names = {e["name"] for e in rec.events() if e.get("ph") == "X"}
+    for phase in boosting.ROUND_PHASES:
+        assert phase in names, f"missing per-round phase span {phase!r}"
+
+
+# ---------------------------------------------------------------- manifest
+def test_run_manifest_schema_and_static_wire_budget(rng, tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.obs.manifest import SCHEMA, write_manifest
+
+    X = rng.randn(300, 4)
+    bst = _train({"objective": "regression", "num_leaves": 7}, X, X[:, 0])
+    cfg = Config({"objective": "regression", "num_leaves": 7})
+    out = tmp_path / "manifest.json"
+    m = write_manifest(str(out), config=cfg, booster=bst,
+                       extra={"note": "test"})
+    on_disk = json.loads(out.read_text())
+    assert on_disk["schema"] == SCHEMA
+    assert m["config"]["resolved"]["objective"] == "regression"
+    assert m["devices"]["device_count"] >= 1
+    assert {"jaxpr_traces", "backend_compiles"} <= set(m["compile"])
+    assert m["model"]["num_trees"] == bst.num_trees()
+    # static wire pins ride along verbatim from cost_budget.json
+    budget = json.loads(
+        (REPO / "lightgbm_tpu" / "analysis" / "cost_budget.json").read_text()
+    )
+    static = m["collectives"]["static_budget_wire_bytes"]
+    assert static == {k: v["wire_bytes"] for k, v in budget.items()}
+    assert m["collectives"]["runtime_wire_bytes_estimate"] >= 0
+
+
+def test_data_parallel_runtime_wire_counter(rng):
+    """tree_learner=data training ticks the runtime collective
+    wire-bytes counter (the manifest's runtime side)."""
+    reg = default_registry()
+    c = reg.counter("lgbmtpu_collective_wire_bytes_total",
+                    labels=("entry",))
+    before = c.value(entry="data_parallel_grow")
+    X = rng.randn(600, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    _train({"objective": "binary", "num_leaves": 7,
+            "tree_learner": "data"}, X, y, rounds=3)
+    after = c.value(entry="data_parallel_grow")
+    assert after > before
+
+
+# ------------------------------------------------------------ re-audit
+def test_instrumentation_added_no_host_callbacks():
+    """All audited jaxpr entries stay callback-free: the observability
+    layer is host-side only (acceptance criterion)."""
+    from lightgbm_tpu.analysis.jaxpr_audit import run_audits
+
+    results = run_audits()
+    checked = 0
+    for r in results:
+        for c in r.contracts:
+            if c.name == "no_host_callbacks":
+                checked += 1
+                assert c.ok, f"{r.name}: {c.detail}"
+    assert checked >= 4  # every hot entry still audited
+
+
+# -------------------------------------------------------------- analysis
+def test_obs_modules_in_analysis_scan():
+    """The strict gate's AST passes (lint + concurrency) cover the new
+    obs/ modules — same file set for both (iter_package_modules)."""
+    from lightgbm_tpu.analysis.lint import iter_package_modules
+
+    files, root = iter_package_modules()
+    rel = {p.relative_to(root).as_posix() for p in files}
+    for mod in ("obs/__init__.py", "obs/metrics.py", "obs/tracing.py",
+                "obs/manifest.py"):
+        assert mod in rel, f"{mod} escaped the analysis scan"
+
+
+# ------------------------------------------------------------------- log
+def test_log_debug_routes_to_debug_method():
+    calls = []
+
+    class L:
+        def info(self, m):
+            calls.append(("info", m))
+
+        def warning(self, m):
+            calls.append(("warning", m))
+
+        def debug(self, m):
+            calls.append(("debug", m))
+
+    prev = (log._logger, log._info_method, log._warning_method,
+            log._debug_method, log._VERBOSITY)
+    try:
+        log.register_logger(L())
+        log.set_verbosity(2)
+        log.debug("d")
+        log.info("i")
+        log.warning("w")
+        assert [c[0] for c in calls] == ["debug", "info", "warning"]
+    finally:
+        (log._logger, log._info_method, log._warning_method,
+         log._debug_method) = prev[:4]
+        log.set_verbosity(prev[4])
+
+
+def test_log_debug_falls_back_to_info_method():
+    calls = []
+
+    class L:
+        def info(self, m):
+            calls.append(("info", m))
+
+        warning = info
+
+    prev = (log._logger, log._info_method, log._warning_method,
+            log._debug_method, log._VERBOSITY)
+    try:
+        log.register_logger(L())
+        log.set_verbosity(2)
+        log.debug("d")
+        assert calls and calls[0][0] == "info"
+        with pytest.raises(TypeError):
+            log.register_logger(L(), debug_method_name="nope")
+    finally:
+        (log._logger, log._info_method, log._warning_method,
+         log._debug_method) = prev[:4]
+        log.set_verbosity(prev[4])
+
+
+def test_log_fatal_only_verbosity_respected_for_registered_logger():
+    calls = []
+
+    class L:
+        def info(self, m):
+            calls.append(m)
+
+        warning = info
+        debug = info
+
+    prev = (log._logger, log._info_method, log._warning_method,
+            log._debug_method, log._VERBOSITY)
+    try:
+        log.register_logger(L())
+        log.set_verbosity(-1)  # fatal-only
+        log.debug("d")
+        log.info("i")
+        log.warning("w")
+        assert calls == []
+        with pytest.raises(log.LightGBMError):
+            log.fatal("boom")
+    finally:
+        (log._logger, log._info_method, log._warning_method,
+         log._debug_method) = prev[:4]
+        log.set_verbosity(prev[4])
+
+
+# ------------------------------------------------------------ bench_serve
+def test_bench_serve_writes_artifact(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_SERVE_DIR", str(tmp_path))
+    monkeypatch.setenv("BENCH_SERVE_TRAIN_ROWS", "400")
+    monkeypatch.setenv("BENCH_SERVE_FEATURES", "4")
+    monkeypatch.setenv("BENCH_SERVE_TREES", "5")
+    monkeypatch.setenv("BENCH_SERVE_LEAVES", "7")
+    monkeypatch.setenv("BENCH_SERVE_REQUESTS", "8")
+    monkeypatch.setenv("BENCH_SERVE_BATCH", "16")
+    monkeypatch.setenv("BENCH_SERVE_THREADS", "2")
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve", REPO / "bench_serve.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+    files = list(tmp_path.glob("BENCH_SERVE_r*.json"))
+    assert len(files) == 1
+    data = json.loads(files[0].read_text())
+    for key in ("qps", "p50_ms", "p99_ms"):
+        assert key in data and data[key] >= 0
+    assert data["requests"] == 8
+    assert data["stats"].get("count", 0) >= 1
+
+
+# --------------------------------------------------------------- profile
+def test_cli_profile_dir_and_manifest(tmp_path, rng):
+    """profile_dir + run_manifest through the CLI: span trace +
+    manifest land in the directory (jax.profiler capture is
+    best-effort on CPU)."""
+    from lightgbm_tpu.cli import main as cli_main
+
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(int)
+    data = tmp_path / "train.csv"
+    np.savetxt(data, np.column_stack([y, X]), delimiter=",", fmt="%.6g")
+    prof = tmp_path / "prof"
+    model = tmp_path / "model.txt"
+    manifest = tmp_path / "manifest.json"
+    rc = cli_main([
+        "task=train", f"data={data}", "objective=binary",
+        "num_leaves=7", "num_trees=3", "verbosity=-1",
+        f"output_model={model}", f"profile_dir={prof}",
+        f"run_manifest={manifest}",
+    ])
+    assert rc == 0
+    trace = json.loads((prof / "trace_events.json").read_text())
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert boosting.FUSED_ROUND_PHASE in names
+    m = json.loads(manifest.read_text())
+    assert m["extra"]["task"] == "train"
+    assert (prof / "run_manifest.json").exists()

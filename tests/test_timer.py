@@ -43,3 +43,73 @@ def test_training_records_phases(capsys):
     finally:
         global_timer.enabled = was
         global_timer.reset()
+
+
+def test_timer_enable_disable_runtime():
+    """enable()/disable() flip timing without a process restart (the
+    runtime analog of the reference's compile-time USE_TIMETAG)."""
+    t = Timer()
+    t.enabled = False
+    with t.scope("off"):
+        pass
+    assert "off" not in t.summary()
+    t.enable(summary_at_exit=False)
+    assert t.enabled
+    with t.scope("on"):
+        pass
+    assert "on" in t.summary()
+    t.disable()
+    with t.scope("off again"):
+        pass
+    assert "off again" not in t.summary()
+
+
+def test_timetag_param_enables_global_timer():
+    """The `timetag` config/CLI param turns the global phase timer on
+    for a training run — no env var, no restart."""
+    was = global_timer.enabled
+    global_timer.disable()
+    global_timer.reset()
+    try:
+        rs = np.random.RandomState(0)
+        X = rs.randn(400, 4)
+        y = (X[:, 0] > 0).astype(float)
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1, "timetag": True},
+                  ds, num_boost_round=2)
+        assert global_timer.enabled
+        assert global_timer.summary()  # phases were recorded
+    finally:
+        global_timer.enabled = was
+        global_timer.reset()
+
+
+def test_block_scope_barrier_syncs_every_local_device(monkeypatch):
+    """The block=True barrier flushes EVERY local device (the old hack
+    synced a single op on the default device only)."""
+    import jax
+
+    from lightgbm_tpu import timer as timer_mod
+
+    seen = []
+    orig = jax.device_put
+
+    def spy(x, device=None, *args, **kwargs):
+        seen.append(device)
+        return orig(x, device, *args, **kwargs)
+
+    monkeypatch.setattr(jax, "device_put", spy)
+    timer_mod._sync_devices()
+    synced = [d for d in seen if d is not None]
+    assert len(synced) == len(jax.local_devices())
+    assert set(synced) == set(jax.local_devices())
+
+    # and scope(block=True) routes through the same barrier
+    seen.clear()
+    t = Timer()
+    t.enabled = True
+    with t.scope("sync", block=True):
+        pass
+    assert len([d for d in seen if d is not None]) == \
+        len(jax.local_devices())

@@ -2,7 +2,9 @@
 # End-to-end serving smoke test (docs/SERVING.md): train a tiny model
 # through the CLI, start the task=serve JSONL loop, score a batch
 # through it, and assert parity against Booster.predict on the same
-# model file. Runs on the CPU backend so it is safe anywhere.
+# model file; then bring up the HTTP transport and assert /healthz +
+# /metrics Prometheus exposition (docs/OBSERVABILITY.md). Runs on the
+# CPU backend so it is safe anywhere.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -65,3 +67,61 @@ err = float(np.abs(served - host).max())
 assert err < 1e-5, f"serve/host mismatch: {err}"
 print(f"serve_smoke: OK ({len(rows)} rows scored, max |diff| {err:.2e})")
 EOF
+
+# HTTP transport: /healthz liveness + /metrics Prometheus exposition
+# (docs/OBSERVABILITY.md) — scrape after scoring and assert the
+# exposition carries the serving counters.
+python - "$WORK" <<'EOF2'
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+work = sys.argv[1]
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]
+s.close()
+proc = subprocess.Popen(
+    [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+     f"input_model={work}/model.txt", f"serve_port={port}",
+     "serve_buckets=16,64", "verbosity=-1"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+)
+base = f"http://127.0.0.1:{port}"
+try:
+    for _ in range(240):
+        if proc.poll() is not None:
+            raise SystemExit(f"serve exited early: {proc.stderr.read()[-2000:]}")
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=2) as r:
+                assert json.loads(r.read())["ok"]
+            break
+        except OSError:
+            time.sleep(0.5)
+    else:
+        raise SystemExit("serve_http never became healthy")
+    req = urllib.request.Request(
+        base + "/v1/score",
+        data=json.dumps({"rows": [[0.0] * 5, [1.0] * 5]}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert json.loads(r.read())["ok"]
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        ctype = r.headers["Content-Type"]
+        text = r.read().decode()
+    assert ctype.startswith("text/plain"), ctype
+    assert "lgbmtpu_serve_requests_total" in text, text[:500]
+    assert "lgbmtpu_serve_protocol_requests_total" in text, text[:500]
+    assert "# TYPE" in text
+    print("serve_smoke http: OK (/healthz + /metrics exposition)")
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+EOF2
