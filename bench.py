@@ -163,10 +163,35 @@ def _final_json():
         )
     for k in ("auc_valid", "trees_done", "warmup_s", "growth_mode",
               "total_trees_per_sec", "quantized", "quantized_trees_per_sec",
-              "quantized_total_trees_per_sec", "quantized_auc_valid"):
+              "quantized_total_trees_per_sec", "quantized_auc_valid",
+              "run_id", "run_manifest"):
         if k in _STATE:
             out[k] = _STATE[k]
     return out
+
+
+def write_run_manifest(params) -> None:
+    """Provenance link (docs/OBSERVABILITY.md): write a run manifest
+    (config, device topology, versions, metrics snapshot) and stamp
+    its path + run id into the BENCH json, so every trajectory point
+    the bench gate reads traces back to what exactly ran."""
+    try:
+        from lightgbm_tpu.obs.manifest import write_manifest
+
+        # durable path next to the BENCH artifacts (like bench_serve's
+        # run_manifest_serve_rNN.json), NOT the tmp partial dir — the
+        # stamped link must still resolve after tmp cleanup. Fixed
+        # name (latest run wins); the run_id inside ties it to its
+        # artifact.
+        mpath = os.environ.get("BENCH_MANIFEST_OUT") or os.path.join(
+            REPO, "run_manifest_bench.json"
+        )
+        write_manifest(mpath, config=dict(params), extra={
+            "bench": "train", "run_id": _STATE["run_id"],
+        })
+        save_partial(run_manifest=mpath)
+    except Exception as e:  # noqa: BLE001 — provenance must not kill the bench
+        sys.stderr.write(f"[bench] run manifest not written: {e}\n")
 
 
 def _emit_final(*_args):
@@ -228,6 +253,7 @@ def _cleanup_partial():
 
 
 def main() -> None:
+    _STATE["run_id"] = f"{int(time.time())}-{os.getpid()}"
     for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGALRM):
         signal.signal(sig, _signal_exit)
     budget = float(os.environ.get("BENCH_BUDGET", 0) or 0)
@@ -444,6 +470,7 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] quantized segment failed: {e}\n")
 
+    write_run_manifest(params)
     _STATE["stage"] = "done"
     _cleanup_partial()
     _emit_final()

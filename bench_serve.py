@@ -36,6 +36,14 @@ import numpy as np
 REPO = os.path.dirname(os.path.abspath(__file__))
 SCHEMA = "lightgbm-tpu/bench-serve/v1"
 
+# last builder-verified ON-CHIP serving measurement — the same
+# carry-forward semantics bench.py uses for training throughput: when
+# a run lands off-chip, this rides along marked `stale: true` so the
+# bench gate (analysis/bench_gate.py) never reads a carried number as
+# fresh. None until the first chip serving run lands; update it there
+# and re-pin with `python -m lightgbm_tpu.analysis --refresh-budgets`.
+LAST_TPU_VERIFIED = None
+
 
 def _env_int(name: str, default: int) -> int:
     try:
@@ -136,7 +144,14 @@ def run_bench() -> dict:
         # what /metrics and the stats op report)
         "stats": registry.stats().get("bench", {}),
         "created_unix": time.time(),
+        "run_id": f"{int(time.time())}-{os.getpid()}",
     }
+    if LAST_TPU_VERIFIED:
+        # same staleness rule as bench.py: carried chip numbers are
+        # fresh only when THIS run actually executed on the chip
+        result["last_tpu_verified"] = dict(
+            LAST_TPU_VERIFIED, stale=result["platform"] != "tpu"
+        )
     return result
 
 
@@ -155,6 +170,23 @@ def _next_out_path() -> str:
 def main() -> int:
     result = run_bench()
     out = _next_out_path()
+    # provenance link: a run manifest (config + device topology +
+    # metrics snapshot) next to the artifact, path stamped into the
+    # json so the trajectory point traces back to what ran
+    mpath = re.sub(r"BENCH_SERVE_r(\d+)\.json$",
+                   r"run_manifest_serve_r\1.json", out)
+    if mpath == out:
+        mpath = out + ".manifest.json"
+    try:
+        from lightgbm_tpu.obs.manifest import write_manifest
+
+        write_manifest(mpath, extra={
+            "bench": "serve", "run_id": result["run_id"],
+            "artifact": out,
+        })
+        result["run_manifest"] = mpath
+    except Exception as e:  # noqa: BLE001 — provenance must not kill the bench
+        sys.stderr.write(f"[bench_serve] run manifest not written: {e}\n")
     with open(out, "w") as f:
         json.dump(result, f, indent=2, sort_keys=True)
         f.write("\n")

@@ -116,6 +116,16 @@ def main(argv=None) -> int:
             for r in results:
                 if not r.ok:
                     print(r.format())
+            # bench trajectory pins ride the same refresh flow
+            from . import bench_gate
+
+            bold, bnew = bench_gate.refresh_budget()
+            print("bench_budget.json updated:")
+            print(bench_gate.format_budget_diff(bold, bnew))
+            gate = bench_gate.run_gate()
+            failed |= not gate.ok
+            if not gate.ok:
+                print(gate.format())
         if failed:
             print("analysis: FAIL (budgets updated, but contracts are "
                   "red)" if args.strict else

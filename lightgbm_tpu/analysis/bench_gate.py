@@ -1,0 +1,316 @@
+"""Bench-trajectory regression gate (Pass 6, docs/STATIC_ANALYSIS.md).
+
+The repo's performance history is checked in as ``BENCH_r*.json``
+(training trees/s) and ``BENCH_SERVE_r*.json`` (serving QPS / latency)
+at the repo root. PR 6 made *static* cost regressions machine-checkable
+(``cost_budget.json``); this pass does the same for the *measured*
+numbers: the newest chip-verified point of each tracked series must not
+regress beyond the pinned headroom in ``bench_budget.json``.
+
+Eligibility rules (what counts as a trajectory point):
+
+- a run whose ``platform`` is ``"tpu"`` contributes its own numbers;
+- a CPU-fallback run contributes its carried-forward
+  ``last_tpu_verified`` block — UNLESS that block is marked
+  ``stale: true`` (the bench marks carried numbers stale when the run
+  never touched the chip, so a dead TPU tunnel cannot keep shipping
+  old numbers as fresh);
+- entries with no parseable payload (``parsed: null`` from a crashed
+  round) are skipped;
+- points are deduplicated by round, a direct measurement beating a
+  carried one for the same round.
+
+Tracked series:
+
+- ``train.trees_per_sec`` / ``train.quantized_trees_per_sec`` —
+  higher is better, gate on a pinned minimum;
+- ``serve.qps`` (higher better, min) and ``serve.p99_ms`` (lower
+  better, max) — gated once a chip-verified serving point exists
+  (bench_serve.py carries the same staleness semantics).
+
+Budget posture matches cost_audit: a series WITH eligible points but
+NO pin fails ("run --refresh-budgets"); a pin whose series lost all
+eligible points fails (the evidence vanished); a series with neither
+points nor pin is reported and passes (serving before its first chip
+run). ``python -m lightgbm_tpu.analysis --refresh-budgets`` rewrites
+``bench_budget.json`` from the current trajectory with
+``_HEADROOM_FRAC`` slack and prints the old->new diff.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from pathlib import Path
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+_BUDGET_PATH = Path(__file__).with_name("bench_budget.json")
+# allowed regression before the gate goes red: min = value * (1 - frac)
+# for higher-better series, max = value * (1 + frac) for lower-better
+_HEADROOM_FRAC = 0.20
+
+
+class SeriesSpec(NamedTuple):
+    group: str       # "train" | "serve"
+    key: str         # budget key + point field name
+    higher_better: bool
+    unit: str
+
+
+SERIES: Tuple[SeriesSpec, ...] = (
+    SeriesSpec("train", "trees_per_sec", True, "trees/s"),
+    SeriesSpec("train", "quantized_trees_per_sec", True, "trees/s"),
+    SeriesSpec("serve", "qps", True, "req/s"),
+    SeriesSpec("serve", "p99_ms", False, "ms"),
+)
+
+
+class BenchPoint(NamedTuple):
+    round: int
+    values: Dict[str, float]  # series key -> value
+    source: str
+    carried: bool             # from a last_tpu_verified block
+
+
+class GateCheck(NamedTuple):
+    name: str
+    ok: bool
+    detail: str
+
+
+class GateResult(NamedTuple):
+    ok: bool
+    checks: Tuple[GateCheck, ...]
+
+    def format(self) -> str:
+        lines = [
+            f"[{'ok' if c.ok else 'FAIL'}] {c.name}: {c.detail}"
+            for c in self.checks
+        ]
+        return "\n".join(lines) if lines else "(no bench trajectory)"
+
+
+# ------------------------------------------------------------ loading
+def repo_root() -> Path:
+    """BENCH artifacts live at the repo root (two levels above this
+    package dir); fall back to cwd for installed-package invocations
+    run from a checkout."""
+    root = Path(__file__).resolve().parents[2]
+    if list(root.glob("BENCH_r*.json")):
+        return root
+    return Path(os.getcwd())
+
+
+def _round_of(path: str, payload: Dict[str, Any],
+              fallback: Optional[int]) -> int:
+    if isinstance(fallback, int):
+        return fallback
+    m = re.search(r"_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def _values_from(src: Dict[str, Any], fields: Dict[str, str]
+                 ) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for key, field in fields.items():
+        v = src.get(field)
+        if isinstance(v, (int, float)) and v > 0:
+            out[key] = float(v)
+    return out
+
+
+_TRAIN_FIELDS = {
+    "trees_per_sec": "value",
+    "quantized_trees_per_sec": "quantized_trees_per_sec",
+}
+_SERVE_FIELDS = {"qps": "qps", "p99_ms": "p99_ms"}
+
+
+def _extract_point(path: str, payload: Dict[str, Any],
+                   fields: Dict[str, str]) -> Optional[BenchPoint]:
+    """One BENCH json -> its chip-verified point (or None)."""
+    if payload.get("platform") == "tpu" and not payload.get("stale"):
+        vals = _values_from(payload, fields)
+        if vals:
+            return BenchPoint(
+                _round_of(path, payload, payload.get("round")),
+                vals, os.path.basename(path), False,
+            )
+    ltv = payload.get("last_tpu_verified")
+    if isinstance(ltv, dict) and not ltv.get("stale") \
+            and ltv.get("platform", "tpu") == "tpu":
+        vals = _values_from(ltv, fields)
+        if vals:
+            return BenchPoint(
+                _round_of(path, ltv, ltv.get("round")),
+                vals, os.path.basename(path), True,
+            )
+    return None
+
+
+def _load_series(root: Path, pattern: str,
+                 fields: Dict[str, str]) -> List[BenchPoint]:
+    points: Dict[int, BenchPoint] = {}
+    for path in sorted(glob.glob(str(root / pattern))):
+        try:
+            data = json.loads(Path(path).read_text())
+        except (OSError, ValueError):
+            continue
+        # driver wrapper {"n", "cmd", "rc", "tail", "parsed"} or a bare
+        # artifact (bench_serve.py writes the payload directly)
+        payload = data.get("parsed") if "parsed" in data else data
+        if not isinstance(payload, dict):
+            continue  # crashed round: parsed is null
+        if "parsed" in data and isinstance(data.get("n"), int) \
+                and "round" not in payload:
+            payload = dict(payload, round=data["n"])
+        pt = _extract_point(path, payload, fields)
+        if pt is None:
+            continue
+        prev = points.get(pt.round)
+        # direct measurement beats a carried one for the same round
+        if prev is None or (prev.carried and not pt.carried):
+            points[pt.round] = pt
+    return [points[r] for r in sorted(points)]
+
+
+def load_trajectory(root: Optional[Path] = None
+                    ) -> Dict[str, List[BenchPoint]]:
+    root = Path(root) if root is not None else repo_root()
+    return {
+        "train": _load_series(root, "BENCH_r*.json", _TRAIN_FIELDS),
+        "serve": _load_series(root, "BENCH_SERVE_r*.json", _SERVE_FIELDS),
+    }
+
+
+def newest_values(trajectory: Dict[str, List[BenchPoint]]
+                  ) -> Dict[str, Dict[str, Any]]:
+    """Per series: the newest eligible value (+ provenance)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for spec in SERIES:
+        for pt in reversed(trajectory.get(spec.group, [])):
+            if spec.key in pt.values:
+                out[f"{spec.group}.{spec.key}"] = {
+                    "value": pt.values[spec.key],
+                    "round": pt.round,
+                    "source": pt.source,
+                    "carried": pt.carried,
+                }
+                break
+    return out
+
+
+# ------------------------------------------------------------- budget
+def load_budget() -> Dict[str, Dict[str, Any]]:
+    if _BUDGET_PATH.exists():
+        return json.loads(_BUDGET_PATH.read_text())
+    return {}
+
+
+def _pin_from(spec: SeriesSpec, value: float, meta: Dict[str, Any]
+              ) -> Dict[str, Any]:
+    bound = (
+        {"min": round(value * (1.0 - _HEADROOM_FRAC), 4)}
+        if spec.higher_better
+        else {"max": round(value * (1.0 + _HEADROOM_FRAC), 4)}
+    )
+    bound["pinned_from"] = {
+        "value": value, "round": meta["round"], "source": meta["source"],
+    }
+    return bound
+
+
+def refresh_budget(root: Optional[Path] = None
+                   ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Rewrite bench_budget.json from the current trajectory; returns
+    (old, new) for the --refresh-budgets diff. Series without eligible
+    points keep their existing pin untouched (a broken tunnel must not
+    silently unpin the gate)."""
+    old = load_budget()
+    newest = newest_values(load_trajectory(root))
+    new = {k: dict(v) for k, v in old.items()}
+    for spec in SERIES:
+        name = f"{spec.group}.{spec.key}"
+        meta = newest.get(name)
+        if meta is not None:
+            new[name] = _pin_from(spec, meta["value"], meta)
+    _BUDGET_PATH.write_text(
+        json.dumps(new, indent=2, sort_keys=True) + "\n"
+    )
+    return old, new
+
+
+def format_budget_diff(old: Dict[str, Any], new: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    for name in sorted(set(old) | set(new)):
+        o, n = old.get(name), new.get(name)
+        if o == n:
+            lines.append(f"  {name}: unchanged")
+            continue
+        for key in ("min", "max"):
+            ov = (o or {}).get(key)
+            nv = (n or {}).get(key)
+            if ov != nv:
+                lines.append(f"~ {name}.{key}: {ov} -> {nv}")
+    return "\n".join(lines) if lines else "  (no pins)"
+
+
+# --------------------------------------------------------------- gate
+def run_gate(root: Optional[Path] = None,
+             budget: Optional[Dict[str, Any]] = None) -> GateResult:
+    trajectory = load_trajectory(root)
+    newest = newest_values(trajectory)
+    if budget is None:
+        budget = load_budget()
+    checks: List[GateCheck] = []
+    for spec in SERIES:
+        name = f"{spec.group}.{spec.key}"
+        pin = budget.get(name)
+        meta = newest.get(name)
+        if pin is None and meta is None:
+            checks.append(GateCheck(
+                name, True,
+                "no chip-verified points yet — unpinned (first chip "
+                "run + --refresh-budgets will pin it)",
+            ))
+            continue
+        if pin is None:
+            checks.append(GateCheck(
+                name, False,
+                f"chip-verified point exists ({meta['value']} "
+                f"{spec.unit} @ r{meta['round']}) but no pin — run "
+                "`python -m lightgbm_tpu.analysis --refresh-budgets`",
+            ))
+            continue
+        if meta is None:
+            checks.append(GateCheck(
+                name, False,
+                "pinned but the trajectory has no eligible point left "
+                "(BENCH files missing/stale?) — the gate refuses to "
+                "pass on vanished evidence",
+            ))
+            continue
+        v = meta["value"]
+        src = (f"r{meta['round']} {meta['source']}"
+               + (" carried" if meta["carried"] else ""))
+        if spec.higher_better:
+            floor = float(pin["min"])
+            ok = v >= floor
+            rel = "<" if not ok else ">="
+            checks.append(GateCheck(
+                name, ok,
+                f"newest {v} {spec.unit} ({src}) {rel} pinned floor "
+                f"{floor} (from {pin.get('pinned_from', {}).get('value')})",
+            ))
+        else:
+            ceil = float(pin["max"])
+            ok = v <= ceil
+            rel = ">" if not ok else "<="
+            checks.append(GateCheck(
+                name, ok,
+                f"newest {v} {spec.unit} ({src}) {rel} pinned ceiling "
+                f"{ceil} (from {pin.get('pinned_from', {}).get('value')})",
+            ))
+    return GateResult(all(c.ok for c in checks), tuple(checks))

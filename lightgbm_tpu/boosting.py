@@ -223,6 +223,13 @@ class GBDT:
         self.best_iteration = -1
         self.valids: List[_ScoreSet] = []
         self._traverse = _jit_traverse()
+        # flight-recorder hooks (obs/recorder.py): engine.train installs
+        # a recorder here when record_file/anomaly_policy is configured;
+        # the loops then publish gh norms (eager: _prepare_gradients;
+        # fused: the eval-row tail collected into _last_gh_rows)
+        self.recorder = None
+        self._last_gh_norm: Optional[Tuple[float, float]] = None
+        self._last_gh_rows: List[Tuple[float, float]] = []
         # ---- async training pipeline (the TPU analog of the reference's
         # synchronous per-iteration loop): under the axon runtime any
         # device->host readback both costs a ~70ms sync AND permanently
@@ -967,6 +974,15 @@ class GBDT:
             gp[:, : ds.num_data] = grad
             hp[:, : ds.num_data] = hess
             grad_dev, hess_dev = jnp.asarray(gp), jnp.asarray(hp)
+        # flight-recorder gh summaries (eager loops only — the fused
+        # step computes its own inside the trace). Host-side float()
+        # syncs, so this runs ONLY when a recorder/sentinel is active;
+        # the default path stays readback-free.
+        if getattr(self, "recorder", None) is not None:
+            self._last_gh_norm = (
+                float(jnp.sqrt(jnp.sum(grad_dev * grad_dev))),
+                float(jnp.sqrt(jnp.sum(hess_dev * hess_dev))),
+            )
         return grad_dev, hess_dev, init_scores
 
     def _train_one_iter_fast(
@@ -1270,6 +1286,13 @@ class GBDT:
         traverse = partial(traverse_tree_bins, has_cat=self.spec.has_cat)
         renew_alpha, renew_w = self._renewal_setup()
         track_train_eval = track_train
+        # flight recorder / sentinels configured -> the step also
+        # returns gh norms on the eval-row tail (static at build time;
+        # part of the memo key through the config string)
+        want_gh = bool(
+            getattr(c, "record_file", "")
+            or getattr(c, "anomaly_policy", "off") != "off"
+        )
         # memo eligibility must be known BEFORE tracing: ranking groups
         # (ndcg/map layouts, lambdarank) need CONCRETE label/group at
         # construction and therefore bake fold data into the trace
@@ -1380,6 +1403,19 @@ class GBDT:
                 # `rows` is a host list: truthiness = len, not a tracer
                 jnp.concatenate(rows) if rows else jnp.zeros(0, jnp.float32)  # lint: allow[tracer-branch]
             )
+            # gradient/hessian norm summaries ride the eval row's tail
+            # (two scalars; fused_collect slices them off) so the
+            # flight recorder gets per-round gh norms from the fused
+            # loop with zero extra readbacks (docs/OBSERVABILITY.md).
+            # Gated on the recorder config so the DEFAULT step keeps
+            # its exact trace — persistent compile-cache entries and
+            # the step memo stay valid for non-recorded runs.
+            if want_gh:
+                gh_row = jnp.stack([
+                    jnp.sqrt(jnp.sum(grad * grad)),
+                    jnp.sqrt(jnp.sum(hess * hess)),
+                ])
+                eval_row = jnp.concatenate([eval_row, gh_row])
             new_state = {
                 "score": score,
                 "vscores": vscores,
@@ -1521,6 +1557,7 @@ class GBDT:
         n_iter_after = len(self._models) // self.num_class
         produced = n_iter_after - n_iter_before
         records: List[List[Tuple[str, str, float, bool]]] = []
+        gh_rows: List[Tuple[float, float]] = []
         for r in range(min(produced, mat.shape[0])):
             row = mat[r]
             out: List[Tuple[str, str, float, bool]] = []
@@ -1530,6 +1567,11 @@ class GBDT:
                     out.append((name, mname, float(row[j]), hb))
                     j += 1
             records.append(out)
+            # the step appends [gnorm, hnorm] after the metric columns
+            # (see _build_fused) — slice them off for the recorder
+            if row.shape[0] >= j + 2:
+                gh_rows.append((float(row[j]), float(row[j + 1])))
+        self._last_gh_rows = gh_rows
         return records
 
     def fused_truncate(self, n_iters: int) -> None:

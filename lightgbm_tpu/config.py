@@ -230,6 +230,16 @@ _PARAMS: Dict[str, _P] = {
     # write a run-manifest JSON (config/topology/compiles/wire bytes)
     # to this path after the task finishes
     "run_manifest": ("", str, ("manifest_file",), None),
+    # flight recorder (obs/recorder.py): stream one JSONL record per
+    # boosting round (phases, learning curve, tree stats, trees/s) to
+    # this path; summarized into the run manifest
+    "record_file": ("", str, ("flight_record",), None),
+    # anomaly sentinels over the flight-record stream
+    # (obs/anomaly.py): off = sentinels don't run; warn = log + metrics
+    # counter + trace instant per trip; abort = additionally raise
+    # AnomalyAbort (the recorder and manifest still flush)
+    "anomaly_policy": ("off", str, (),
+                       lambda v: v in ("off", "warn", "abort")),
 }
 
 # alias -> canonical name

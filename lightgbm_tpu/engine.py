@@ -24,6 +24,154 @@ def _resolve_num_boost_round(params: Dict[str, Any], num_boost_round: int) -> Tu
     return params, num_boost_round
 
 
+class _ObsHooks:
+    """Flight recorder + anomaly sentinel wiring for train()'s two
+    loops (docs/OBSERVABILITY.md "Flight recorder & anomaly policies").
+
+    One record per boosting round: evals (with higher-better flags for
+    the loss-spike sentinel), per-phase durations drained from the
+    timer span sink, per-class tree stats when the round's host trees
+    are materialized (fused: every chunk; eager sync: every round; the
+    async fast path defers trees, so those records omit stats), gh
+    norms, and chunk throughput. Every record is written+flushed before
+    the sentinel sees it, so an ``anomaly_policy=abort`` trip can never
+    lose the round that tripped it."""
+
+    def __init__(self, recorder, sentinel):
+        self.recorder = recorder
+        self.sentinel = sentinel
+        self._gbdt = None
+        self._chunk_tps: Optional[float] = None
+        self._step_durs: List[float] = []
+        self._chunk_phases: Dict[str, float] = {}
+        self._gh_rows: List[Tuple[float, float]] = []
+
+    def bind(self, gbdt) -> None:
+        self._gbdt = gbdt
+        gbdt.recorder = self.recorder  # eager loops publish gh norms
+        self.recorder.attach()
+
+    # ------------------------------------------------------------------
+    def _tree_stats(self, i: int):
+        """Stats for iteration i's K class-trees, when materialized."""
+        gbdt = self._gbdt
+        if gbdt._pending:
+            return None  # async fast path: host trees not yet fetched
+        K = gbdt.num_class
+        base = (gbdt._init_iters + i) * K
+        models = gbdt._models
+        if len(models) < base + K:
+            return None
+        from .obs.recorder import tree_stats
+
+        return tree_stats(models[base: base + K])
+
+    def _fill_evals(self, rec: Dict[str, Any], evals) -> None:
+        # tuples are (dataset, metric, value, higher_better[, stdv]);
+        # index access keeps custom-feval 5-tuples working too
+        if not evals:
+            return
+        rec["evals"] = {
+            f"{it[0]} {it[1]}": float(it[2]) for it in evals
+        }
+        rec["evals_hb"] = {
+            f"{it[0]} {it[1]}": bool(it[3])
+            for it in evals if len(it) > 3
+        }
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        self.recorder.record(rec)
+        if self.sentinel is not None:
+            self.sentinel.check(rec)  # abort policy raises AnomalyAbort
+
+    # ------------------------------------------------------------------
+    def start_chunk(self, n_records: int, chunk_seconds: float) -> None:
+        """Fused chunk boundary: drain the span sink once and slice the
+        per-round ``round: fused step`` spans out; chunk-level scopes
+        (dispatch/collect/materialize) ride the chunk's first record."""
+        from .boosting import FUSED_ROUND_PHASE
+
+        drained = self.recorder.drain_phases()
+        self._step_durs = drained.pop(FUSED_ROUND_PHASE, [])
+        self._chunk_phases = {
+            k: round(sum(v), 6) for k, v in drained.items()
+        }
+        K = self._gbdt.num_class
+        self._chunk_tps = (
+            n_records * K / chunk_seconds
+            if n_records and chunk_seconds > 0 else None
+        )
+        self._gh_rows = list(self._gbdt._last_gh_rows)
+
+    def fused_round(self, i: int, j: int, evals) -> None:
+        from .boosting import FUSED_ROUND_PHASE
+
+        rec: Dict[str, Any] = {"round": i, "t_unix": time.time()}
+        if j < len(self._step_durs):
+            rec["phases"] = {
+                FUSED_ROUND_PHASE: round(self._step_durs[j], 6)
+            }
+        if j == 0 and self._chunk_phases:
+            rec["chunk_phases"] = self._chunk_phases
+        if self._chunk_tps is not None:
+            rec["trees_per_sec"] = round(self._chunk_tps, 4)
+        if j < len(self._gh_rows):
+            rec["gnorm"], rec["hnorm"] = (
+                round(self._gh_rows[j][0], 6),
+                round(self._gh_rows[j][1], 6),
+            )
+        self._fill_evals(rec, evals)
+        ts = self._tree_stats(i)
+        if ts is not None:
+            rec["trees"] = ts
+        self._emit(rec)
+
+    def eager_round(self, i: int, evals, iter_seconds: float) -> None:
+        rec: Dict[str, Any] = {"round": i, "t_unix": time.time()}
+        drained = self.recorder.drain_phases()
+        if drained:
+            rec["phases"] = {
+                k: round(sum(v), 6) for k, v in drained.items()
+            }
+        if iter_seconds > 0:
+            rec["trees_per_sec"] = round(
+                self._gbdt.num_class / iter_seconds, 4
+            )
+        gh = self._gbdt._last_gh_norm
+        if gh is not None:
+            rec["gnorm"], rec["hnorm"] = round(gh[0], 6), round(gh[1], 6)
+        self._fill_evals(rec, evals)
+        ts = self._tree_stats(i)
+        if ts is not None:
+            rec["trees"] = ts
+        self._emit(rec)
+
+    def close(self) -> None:
+        """Exception-safe teardown (train()'s finally): detaches the
+        timer sink and flushes/closes the JSONL stream so an abort
+        leaves no torn state behind. Also unhooks the booster — a kept
+        training booster must not keep paying the gh-norm readbacks
+        into a closed recorder."""
+        if self._gbdt is not None:
+            self._gbdt.recorder = None
+        self.recorder.close()
+
+
+def _make_obs_hooks(cfg) -> Optional[_ObsHooks]:
+    """record_file / anomaly_policy config -> hooks (None = both off,
+    the default: zero per-round overhead)."""
+    path = cfg.record_file
+    policy = cfg.anomaly_policy
+    if not path and policy == "off":
+        return None
+    from .obs.anomaly import make_sentinel
+    from .obs.recorder import FlightRecorder
+
+    recorder = FlightRecorder(path or None)
+    sentinel = make_sentinel(policy, recorder=recorder)
+    return _ObsHooks(recorder, sentinel)
+
+
 def train(
     params: Dict[str, Any],
     train_set: Dataset,
@@ -101,6 +249,19 @@ def train(
             booster.save_model(out, num_iteration=total)
             log.info(f"Saved snapshot to {out}")
 
+    # flight recorder + anomaly sentinels (record_file / anomaly_policy
+    # params, docs/OBSERVABILITY.md); None when both are off
+    obs_hooks = _make_obs_hooks(cfg_probe)
+    if obs_hooks is not None:
+        obs_hooks.bind(booster._gbdt)
+    else:
+        # an unrecorded run supersedes any earlier recorded run: a
+        # manifest written after THIS run must not carry the previous
+        # run's flight-record summary
+        from .obs.recorder import clear_last_summary
+
+        clear_last_summary()
+
     evaluation_result_list: List[Tuple] = []
     i = -1
     use_fused = (
@@ -128,85 +289,107 @@ def train(
             f"Using the per-iteration sync training loop ({why}); "
             "the fused device loop is faster on accelerators"
         )
-    if use_fused:
-        # fused device loop: one jit dispatch per iteration, zero host
-        # syncs; evals fetched per chunk and callbacks replayed in order
-        # (identical per-iteration semantics, delivered late)
-        gbdt = booster._gbdt
-        gbdt.train.name = booster._train_data_name
-        gbdt.fused_start(track_train=valid_contain_train)
-        chunk = gbdt._check_every
-        done = 0
-        stop = False
-        from .obs.metrics import record_training_round
-        from .timer import global_timer as _gt
+    try:
+        if use_fused:
+            # fused device loop: one jit dispatch per iteration, zero host
+            # syncs; evals fetched per chunk and callbacks replayed in order
+            # (identical per-iteration semantics, delivered late)
+            gbdt = booster._gbdt
+            gbdt.train.name = booster._train_data_name
+            gbdt.fused_start(track_train=valid_contain_train)
+            chunk = gbdt._check_every
+            done = 0
+            stop = False
+            from .obs.metrics import record_eval_values, record_training_round
+            from .timer import global_timer as _gt
 
-        while done < num_boost_round and not stop:
-            n = min(chunk, num_boost_round - done)
-            t_chunk = time.perf_counter()
-            with _gt.scope("fused dispatch"):
-                gbdt.fused_dispatch(n)
-            with _gt.scope("fused collect (readback)"):
-                records = gbdt.fused_collect()
-            record_training_round(
-                len(records), len(records) * gbdt.num_class,
-                time.perf_counter() - t_chunk,
-            )
-            for j, evals in enumerate(records):
-                i = done + j
-                evaluation_result_list = evals
-                _snapshot(i)
-                try:
-                    for cb in cb_after:
-                        cb(CallbackEnv(booster, params, i, 0, num_boost_round, evals))
-                except EarlyStopException as e:
-                    booster.best_iteration = e.best_iteration + 1
-                    evaluation_result_list = e.best_score
-                    # truncate counts TOTAL iterations: keep loaded trees
-                    gbdt.fused_truncate(gbdt._init_iters + i + 1)
-                    stop = True
-                    break
-            done += max(len(records), 1)
-            if gbdt._stopped:
-                # the sync path runs cb_after once for the stop iteration
-                # (whose eval equals the previous iteration's: the failed
-                # trees were rolled back) — replay that here too
-                if not stop and done < num_boost_round:
+            while done < num_boost_round and not stop:
+                n = min(chunk, num_boost_round - done)
+                t_chunk = time.perf_counter()
+                with _gt.scope("fused dispatch"):
+                    gbdt.fused_dispatch(n)
+                with _gt.scope("fused collect (readback)"):
+                    records = gbdt.fused_collect()
+                record_training_round(
+                    len(records), len(records) * gbdt.num_class,
+                    time.perf_counter() - t_chunk,
+                )
+                if obs_hooks is not None:
+                    obs_hooks.start_chunk(
+                        len(records), time.perf_counter() - t_chunk
+                    )
+                for j, evals in enumerate(records):
+                    i = done + j
+                    evaluation_result_list = evals
+                    record_eval_values(evals)
+                    if obs_hooks is not None:
+                        obs_hooks.fused_round(i, j, evals)
+                    _snapshot(i)
                     try:
                         for cb in cb_after:
-                            cb(CallbackEnv(booster, params, done, 0,
-                                           num_boost_round, evaluation_result_list))
+                            cb(CallbackEnv(booster, params, i, 0, num_boost_round, evals))
                     except EarlyStopException as e:
                         booster.best_iteration = e.best_iteration + 1
                         evaluation_result_list = e.best_score
-                break
-    else:
-        from .obs.metrics import record_training_round
+                        # truncate counts TOTAL iterations: keep loaded trees
+                        gbdt.fused_truncate(gbdt._init_iters + i + 1)
+                        stop = True
+                        break
+                done += max(len(records), 1)
+                if gbdt._stopped:
+                    # the sync path runs cb_after once for the stop iteration
+                    # (whose eval equals the previous iteration's: the failed
+                    # trees were rolled back) — replay that here too
+                    if not stop and done < num_boost_round:
+                        try:
+                            for cb in cb_after:
+                                cb(CallbackEnv(booster, params, done, 0,
+                                               num_boost_round, evaluation_result_list))
+                        except EarlyStopException as e:
+                            booster.best_iteration = e.best_iteration + 1
+                            evaluation_result_list = e.best_score
+                    break
+        else:
+            from .obs.metrics import record_eval_values, record_training_round
 
-        for i in range(num_boost_round):
-            for cb in cb_before:
-                cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
-            t_iter = time.perf_counter()
-            finished = booster.update(fobj=fobj)
-            record_training_round(
-                1, booster._gbdt.num_class, time.perf_counter() - t_iter
-            )
+            for i in range(num_boost_round):
+                for cb in cb_before:
+                    cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
+                t_iter = time.perf_counter()
+                finished = booster.update(fobj=fobj)
+                record_training_round(
+                    1, booster._gbdt.num_class, time.perf_counter() - t_iter
+                )
 
-            evaluation_result_list = []
-            if valid_contain_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            if booster._gbdt.valids:
-                evaluation_result_list.extend(booster.eval_valid(feval))
-            _snapshot(i)
-            try:
-                for cb in cb_after:
-                    cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
-            except EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                evaluation_result_list = e.best_score
-                break
-            if finished:
-                break
+                evaluation_result_list = []
+                if valid_contain_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                if booster._gbdt.valids:
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+                record_eval_values(evaluation_result_list)
+                if obs_hooks is not None:
+                    obs_hooks.eager_round(
+                        i, evaluation_result_list,
+                        time.perf_counter() - t_iter,
+                    )
+                _snapshot(i)
+                try:
+                    for cb in cb_after:
+                        cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
+                except EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    evaluation_result_list = e.best_score
+                    break
+                if finished:
+                    break
+
+    finally:
+        # exception-safe flush (anomaly abort, callback errors,
+        # KeyboardInterrupt): detach the span sink and close the
+        # JSONL stream so the flight record's tail stays parseable
+        # and the run manifest can summarize it
+        if obs_hooks is not None:
+            obs_hooks.close()
 
     # flush the async training pipeline (fast-path pending device trees)
     booster._gbdt._materialize()

@@ -14,10 +14,21 @@ instrumented entries):
 - ``manifest`` — per-run **manifest JSON**: config, device topology,
   compile counts (retrace guard), phase timings, metrics snapshot, and
   runtime collective wire bytes vs the static ``cost_budget.json``
-  pins.
+  pins;
+- ``recorder`` — the **flight recorder**: one JSONL record per
+  boosting round (phases, learning curve, tree stats, throughput),
+  enabled via the ``record_file=`` config/CLI param;
+- ``anomaly`` — **sentinels** over the flight-record stream (NaN/Inf,
+  loss spikes, throughput collapse, dead rounds) behind the
+  ``anomaly_policy=off|warn|abort`` knob;
+- ``aggregate`` — **fleet aggregation**: merges per-process registry
+  snapshots and recorder streams host-side (files / ``/metrics``
+  pulls, explicitly no jax collectives).
 """
 
-from . import manifest, metrics, tracing
+from . import aggregate, anomaly, manifest, metrics, recorder, tracing
+from .anomaly import AnomalyAbort, AnomalySentinel
+from .recorder import FlightRecorder
 from .manifest import build_manifest, write_manifest
 from .metrics import (
     Counter,
@@ -46,6 +57,12 @@ __all__ = [
     "metrics",
     "tracing",
     "manifest",
+    "recorder",
+    "anomaly",
+    "aggregate",
+    "AnomalyAbort",
+    "AnomalySentinel",
+    "FlightRecorder",
     "build_manifest",
     "write_manifest",
 ]

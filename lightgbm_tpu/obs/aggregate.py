@@ -1,0 +1,221 @@
+"""Fleet-level metric aggregation — host-side, no jax collectives.
+
+Multi-host training (parallel/multihost.py) and multi-replica serving
+run one metrics registry PER PROCESS. This module merges those views
+into one fleet picture using only host-side transport — snapshot
+files on a shared filesystem, or HTTP pulls from each worker's
+``/metrics`` endpoint — deliberately NOT jax collectives: the CPU
+backend used by tier-1 has no cross-process collectives
+(docs/DESIGN_DECISIONS.md, the xfail'd multihost tests), and
+observability must keep working exactly when the training fabric is
+the thing that broke.
+
+Three sources, one merged shape:
+
+- ``write_snapshot(path)`` / ``read_snapshot(path)`` — one process
+  dumps its registry (samples WITH metric kinds, schema below);
+- ``pull_snapshot(url)`` — scrape a worker's Prometheus ``/metrics``
+  endpoint and parse the text exposition back into the same shape;
+- ``merge(snapshots)`` — fold N snapshots into a fleet view: counter
+  and histogram samples SUM across processes (fleet totals — wire
+  bytes, trips, request counts), gauge samples sum too with per-key
+  ``min``/``max`` ride-alongs (fleet trees/s is the sum of per-worker
+  trees/s; the min/max spread is how a straggler shows up).
+
+Recorder streams merge the same way: ``merge_recorder_streams``
+zips per-process flight records by round (lockstep training writes
+one record per round per process) into per-round fleet rows.
+
+Rendered for humans by ``tools/obs_report.py``; consumed
+programmatically by ``parallel.multihost.merged_fleet_snapshot``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.request
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+SCHEMA = "lightgbm-tpu/metrics-snapshot/v1"
+
+# kinds whose samples are additive across processes; gauges are summed
+# too but annotated with min/max so stragglers stay visible
+_SUMMED_KINDS = ("counter", "histogram")
+
+
+def snapshot_dict(registry=None, process: Optional[int] = None
+                  ) -> Dict[str, Any]:
+    """One process's registry as a JSON-serializable snapshot (samples
+    keyed by rendered label string, kind preserved per metric)."""
+    import jax
+
+    from .metrics import _render_labels, default_registry
+
+    reg = registry if registry is not None else default_registry()
+    metrics: Dict[str, Dict[str, Any]] = {}
+    for s in reg.samples():
+        fam = metrics.setdefault(
+            s.name, {"kind": s.kind, "help": s.help, "values": {}}
+        )
+        fam["values"][_render_labels(s.labels)] = float(s.value)
+    if process is None:
+        try:
+            process = jax.process_index()
+        except Exception:  # noqa: BLE001 — snapshot must not need a backend
+            process = 0
+    return {"schema": SCHEMA, "process": int(process), "metrics": metrics}
+
+
+def write_snapshot(path: str, registry=None,
+                   process: Optional[int] = None) -> Dict[str, Any]:
+    snap = snapshot_dict(registry, process)
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return snap
+
+
+def read_snapshot(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        snap = json.load(f)
+    if snap.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path} is not a metrics snapshot (schema "
+            f"{snap.get('schema')!r} != {SCHEMA!r})"
+        )
+    return snap
+
+
+# ---------------------------------------------------- prometheus pull
+_PROM_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$"
+)
+
+
+def parse_prometheus(text: str, process: int = 0) -> Dict[str, Any]:
+    """Text exposition (format 0.0.4) -> the snapshot shape above.
+    Histogram component samples (_bucket/_sum/_count) keep their full
+    sample name; the family kind comes from the # TYPE line."""
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    metrics: Dict[str, Dict[str, Any]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, kind = rest.partition(" ")
+            kinds[fam] = kind.strip()
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            fam, _, h = rest.partition(" ")
+            helps[fam] = h
+            continue
+        if line.startswith("#"):
+            continue
+        m = _PROM_SAMPLE.match(line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in kinds:
+                fam = name[: -len(suffix)]
+                break
+        entry = metrics.setdefault(name, {
+            "kind": kinds.get(fam, "untyped"),
+            "help": helps.get(fam, ""),
+            "values": {},
+        })
+        entry["values"][labels] = float(value)
+    return {"schema": SCHEMA, "process": int(process), "metrics": metrics}
+
+
+def pull_snapshot(url: str, timeout: float = 10.0,
+                  process: int = 0) -> Dict[str, Any]:
+    """HTTP-scrape one worker's ``/metrics`` endpoint (the serving
+    transport's route, server.py) into a snapshot."""
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return parse_prometheus(r.read().decode(), process=process)
+
+
+# --------------------------------------------------------------- merge
+def merge(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-process snapshots into one fleet view."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    for snap in snapshots:
+        for name, fam in (snap.get("metrics") or {}).items():
+            out = merged.setdefault(name, {
+                "kind": fam.get("kind", "untyped"),
+                "help": fam.get("help", ""),
+                "values": {},
+                "min": {},
+                "max": {},
+            })
+            for key, v in (fam.get("values") or {}).items():
+                v = float(v)
+                out["values"][key] = out["values"].get(key, 0.0) + v
+                out["min"][key] = min(out["min"].get(key, v), v)
+                out["max"][key] = max(out["max"].get(key, v), v)
+    for fam in merged.values():
+        if fam["kind"] in _SUMMED_KINDS:
+            # additive families need no spread annotations
+            fam.pop("min")
+            fam.pop("max")
+    return {
+        "schema": SCHEMA + "+merged",
+        "processes": len(snapshots),
+        "metrics": merged,
+    }
+
+
+def merge_files(paths: Iterable[str]) -> Dict[str, Any]:
+    return merge([read_snapshot(p) for p in sorted(paths)])
+
+
+# ---------------------------------------------------- recorder streams
+def merge_recorder_streams(
+    streams: Sequence[List[Dict[str, Any]]]
+) -> List[Dict[str, Any]]:
+    """Zip per-process flight-record streams by round index into fleet
+    rows. Lockstep data-parallel training produces identical metric
+    values on every rank (the collective makes them so) — the merged
+    row keeps rank 0's evals and annotates disagreement; throughput
+    sums; per-phase durations keep the fleet max (the straggler bound,
+    which is what a lockstep collective actually waits on)."""
+    by_round: Dict[int, List[Dict[str, Any]]] = {}
+    for stream in streams:
+        for rec in stream:
+            by_round.setdefault(int(rec.get("round", -1)), []).append(rec)
+    out: List[Dict[str, Any]] = []
+    for rnd in sorted(by_round):
+        recs = by_round[rnd]
+        row: Dict[str, Any] = {"round": rnd, "processes": len(recs)}
+        evals = [r.get("evals") for r in recs if r.get("evals")]
+        if evals:
+            row["evals"] = dict(evals[0])
+            drift = {
+                k for e in evals[1:] for k, v in e.items()
+                if abs(float(v) - float(evals[0].get(k, v))) > 1e-9
+            }
+            if drift:
+                # lockstep broke: ranks disagree on the metric value —
+                # surface it, never average it away
+                row["evals_disagree"] = sorted(drift)
+        tps = [float(r["trees_per_sec"]) for r in recs
+               if r.get("trees_per_sec")]
+        if tps:
+            row["trees_per_sec"] = sum(tps)
+        phases: Dict[str, float] = {}
+        for r in recs:
+            for name, dur in (r.get("phases") or {}).items():
+                phases[name] = max(phases.get(name, 0.0), float(dur))
+        if phases:
+            row["phases_max"] = phases
+        out.append(row)
+    return out

@@ -125,6 +125,14 @@ def build_manifest(config: Optional[Any] = None,
             "static_budget_wire_bytes": _static_wire_budget(),
         },
     }
+    # fold in the most recent flight record (docs/OBSERVABILITY.md):
+    # rounds recorded, stream path, final evals, anomaly trip counts —
+    # the longitudinal run summary next to the point-in-time snapshot
+    from .recorder import last_summary
+
+    fr = last_summary()
+    if fr is not None:
+        manifest["flight_recorder"] = fr
     if booster is not None:
         try:
             manifest["model"] = {

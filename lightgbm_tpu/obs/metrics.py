@@ -297,6 +297,13 @@ class MetricsRegistry:
                 self._collectors.remove(fn)
 
     # ------------------------------------------------------------------
+    def samples(self) -> List[Sample]:
+        """Every current sample (metrics + collectors) — the public
+        scrape view obs.aggregate serializes for host-side fleet
+        merging (each Sample carries its kind, so the merger knows
+        counters sum and gauges don't)."""
+        return self._all_samples()
+
     def _all_samples(self) -> List[Sample]:
         with self._lock:
             metrics = list(self._metrics.values())
@@ -425,6 +432,23 @@ def record_training_round(n_iters: int, n_trees: int,
         r.histogram("lgbmtpu_train_chunk_seconds",
                     "wall seconds per dispatched training chunk"
                     ).observe(seconds)
+
+
+def record_eval_values(evals) -> None:
+    """Per-round evaluation results as labeled gauges: every
+    ``(dataset, metric, value, higher_better)`` tuple the training loop
+    produces (the same rows ``callback.record_evaluation`` collects)
+    lands on ``lgbmtpu_eval_metric{dataset,metric}`` — learning curves
+    on /metrics with no custom callback (docs/OBSERVABILITY.md)."""
+    r = _default
+    if not r.enabled or not evals:
+        return
+    g = r.gauge("lgbmtpu_eval_metric",
+                "most recent per-round evaluation metric value",
+                labels=("dataset", "metric"))
+    for item in evals:
+        ds_name, metric, value = item[0], item[1], item[2]
+        g.set(float(value), dataset=ds_name, metric=metric)
 
 
 def record_bucket_dispatch(entry: str, bucket: int, rows: int) -> None:

@@ -162,6 +162,45 @@ def global_rows(arr: np.ndarray, mesh, axis: int = 0):
     return jax.make_array_from_process_local_data(sharding, arr)
 
 
+def write_metrics_snapshot(out_dir: str) -> str:
+    """Dump THIS process's metrics registry as a snapshot file in a
+    shared directory (obs/aggregate.py schema). Pure host-side I/O —
+    deliberately not a jax collective, so fleet observability works on
+    backends without cross-process collectives (the xfail'd CPU
+    multihost configuration, docs/DESIGN_DECISIONS.md) and keeps
+    working when the training fabric itself is what broke."""
+    import os
+
+    import jax
+
+    from ..obs import aggregate
+
+    os.makedirs(out_dir, exist_ok=True)
+    rank = jax.process_index()
+    path = os.path.join(out_dir, f"metrics_rank{rank:05d}.json")
+    aggregate.write_snapshot(path, process=rank)
+    return path
+
+
+def merged_fleet_snapshot(out_dir: str):
+    """Merge every worker's snapshot file from `out_dir` into one
+    fleet view (counters sum across processes; gauges sum with min/max
+    spread — see obs/aggregate.py). Any process can call this; it
+    reads only files."""
+    import glob
+    import os
+
+    from ..obs import aggregate
+
+    paths = glob.glob(os.path.join(out_dir, "metrics_rank*.json"))
+    if not paths:
+        raise FileNotFoundError(
+            f"no metrics_rank*.json snapshots under {out_dir}; call "
+            "write_metrics_snapshot on each worker first"
+        )
+    return aggregate.merge_files(paths)
+
+
 def run_distributed(
     params: dict,
     X: np.ndarray,
@@ -177,6 +216,7 @@ def run_distributed(
     group: Optional[np.ndarray] = None,
     valid: Optional[tuple] = None,  # (Xv, yv) — rank-local validation shard
     callbacks: Optional[list] = None,
+    obs_snapshot_dir: Optional[str] = None,  # shared dir for fleet metrics
 ):
     """One-call multi-host training — the python-package analog of
     dask.py:415 `_train`: joins the cluster from reference-style network
@@ -255,4 +295,32 @@ def run_distributed(
         callbacks=callbacks,
     )
     bst._distributed_rank = rank
+    if obs_snapshot_dir:
+        # fleet observability: every rank dumps its registry; rank 0
+        # merges the files into one view (host-side only — works even
+        # where jax cross-process collectives don't). Deliberately no
+        # barrier: ranks that haven't flushed yet are just absent, so
+        # the merge reports HOW MANY snapshots it saw and warns when
+        # partial — re-merge offline via merged_fleet_snapshot once
+        # every worker has written.
+        write_metrics_snapshot(obs_snapshot_dir)
+        if rank == 0:
+            merged = merged_fleet_snapshot(obs_snapshot_dir)
+            bst._fleet_metrics = merged
+            from .. import log
+
+            n = merged.get("processes", 0)
+            total = jax.process_count()
+            if n < total:
+                log.warning(
+                    f"fleet metrics merged from only {n}/{total} worker "
+                    f"snapshot(s) under {obs_snapshot_dir} — stragglers "
+                    "missing; re-merge offline with "
+                    "merged_fleet_snapshot for the complete view"
+                )
+            else:
+                log.info(
+                    f"fleet metrics merged from {n} worker snapshot(s) "
+                    f"under {obs_snapshot_dir}"
+                )
     return bst

@@ -32,10 +32,14 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Iterator, List, Optional
 
-# active span sink: obs.tracing installs `(name, start_s, dur_s) ->
-# None` here while recording (module attribute, not a Timer field, so
-# one recorder observes every Timer instance)
-_trace_sink: Optional[Callable[[str, float, float], None]] = None
+# active span sinks: obs.tracing installs `(name, start_s, dur_s) ->
+# None` here while recording, and obs.recorder adds its per-round
+# phase accumulator alongside (module attributes, not Timer fields, so
+# the subscribers observe every Timer instance). `set_trace_sink`
+# keeps its original single-slot semantics for obs.tracing; extra
+# subscribers ride `add_trace_sink`/`remove_trace_sink`.
+_trace_sinks: tuple = ()
+_primary_sink: Optional[Callable[[str, float, float], None]] = None
 
 
 def set_trace_sink(
@@ -43,9 +47,29 @@ def set_trace_sink(
 ) -> None:
     """Install (or clear, with None) the span recorder scopes report
     to. Owned by obs.tracing; exposed here so timer stays a leaf
-    module with no obs import."""
-    global _trace_sink
-    _trace_sink = sink
+    module with no obs import. Replaces only the slot it owns — sinks
+    added through add_trace_sink are unaffected."""
+    global _trace_sinks, _primary_sink
+    sinks = [s for s in _trace_sinks if s is not _primary_sink]
+    _primary_sink = sink
+    if sink is not None:
+        sinks.append(sink)
+    _trace_sinks = tuple(sinks)
+
+
+def add_trace_sink(sink: Callable[[str, float, float], None]) -> None:
+    """Subscribe an additional span sink (obs.recorder's per-round
+    phase accumulator); idempotent."""
+    global _trace_sinks
+    if sink not in _trace_sinks:
+        _trace_sinks = _trace_sinks + (sink,)
+
+
+def remove_trace_sink(sink: Callable[[str, float, float], None]) -> None:
+    # equality, not identity: a bound method is a fresh object on each
+    # attribute access, so `is` would never match the stored sink
+    global _trace_sinks
+    _trace_sinks = tuple(s for s in _trace_sinks if s != sink)
 
 
 def _sync_devices() -> None:
@@ -92,8 +116,8 @@ class Timer:
         """Time a region; with block=True waits for completion of all
         dispatched device work (every local device) before stopping
         the clock, so the region includes its dispatched work."""
-        sink = _trace_sink
-        if not self.enabled and sink is None:
+        sinks = _trace_sinks
+        if not self.enabled and not sinks:
             yield
             return
         import jax
@@ -107,7 +131,7 @@ class Timer:
         if self.enabled:
             self._acc[name] = self._acc.get(name, 0.0) + dt
             self._cnt[name] = self._cnt.get(name, 0) + 1
-        if sink is not None:
+        for sink in sinks:
             sink(name, t0, dt)
 
     def add(self, name: str, seconds: float,
@@ -118,11 +142,12 @@ class Timer:
         if self.enabled:
             self._acc[name] = self._acc.get(name, 0.0) + seconds
             self._cnt[name] = self._cnt.get(name, 0) + 1
-        sink = _trace_sink
-        if sink is not None:
+        sinks = _trace_sinks
+        if sinks:
             if start is None:
                 start = time.perf_counter() - seconds
-            sink(name, start, seconds)
+            for sink in sinks:
+                sink(name, start, seconds)
 
     def summary(self) -> Dict[str, tuple]:
         return {

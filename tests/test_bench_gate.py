@@ -1,0 +1,220 @@
+"""Bench-trajectory regression gate (analysis/bench_gate.py, Pass 6):
+trajectory parsing with stale/cpu-fallback filtering, red-to-green on
+crafted regressed fixtures, the budget refresh flow, and registration
+in the strict gate's pass registry."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from lightgbm_tpu.analysis import bench_gate
+from lightgbm_tpu.analysis.bench_gate import (
+    load_trajectory,
+    newest_values,
+    run_gate,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _write(root: Path, name: str, payload):
+    (root / name).write_text(json.dumps(payload) + "\n")
+
+
+def _wrap(n, parsed):
+    """Driver wrapper shape ({"n", "parsed"}) used by BENCH_r*.json."""
+    return {"n": n, "cmd": "python bench.py", "rc": 0, "parsed": parsed}
+
+
+# ----------------------------------------------------------- trajectory
+def test_checked_in_trajectory_parses_and_gate_is_green():
+    """ACCEPTANCE: the gate runs green on the repo's real trajectory
+    (and the budget pins actually exist — a missing pin would be red)."""
+    traj = load_trajectory()
+    assert traj["train"], "checked-in BENCH files produced no points"
+    newest = newest_values(traj)
+    assert newest["train.trees_per_sec"]["value"] > 0
+    result = run_gate()
+    assert result.ok, result.format()
+    names = {c.name for c in result.checks}
+    assert {"train.trees_per_sec", "train.quantized_trees_per_sec",
+            "serve.qps", "serve.p99_ms"} <= names
+
+
+def test_stale_and_cpu_fallback_entries_are_ignored(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _wrap(1, {
+        "value": 10.0, "platform": "tpu", "unit": "trees/sec",
+    }))
+    # r2 crashed: parsed is null
+    _write(tmp_path, "BENCH_r02.json", _wrap(2, None))
+    # r3 ran on cpu and its carried block is STALE -> contributes nothing
+    _write(tmp_path, "BENCH_r03.json", _wrap(3, {
+        "value": 0.05, "platform": "cpu",
+        "last_tpu_verified": {"value": 99.0, "platform": "tpu",
+                              "round": 3, "stale": True},
+    }))
+    traj = load_trajectory(tmp_path)
+    assert [(p.round, p.values["trees_per_sec"]) for p in traj["train"]] \
+        == [(1, 10.0)]
+    # a NON-stale carried block does contribute, as a carried point
+    _write(tmp_path, "BENCH_r04.json", _wrap(4, {
+        "value": 0.05, "platform": "cpu",
+        "last_tpu_verified": {"value": 12.0, "platform": "tpu",
+                              "round": 4},
+    }))
+    traj = load_trajectory(tmp_path)
+    newest = newest_values(traj)["train.trees_per_sec"]
+    assert newest["value"] == 12.0 and newest["carried"]
+
+
+def test_direct_measurement_beats_carried_for_same_round(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _wrap(1, {
+        "value": 8.0, "platform": "tpu",
+    }))
+    # another artifact carrying round 1 at a slightly different value
+    _write(tmp_path, "BENCH_r02.json", _wrap(2, {
+        "platform": "cpu",
+        "last_tpu_verified": {"value": 7.9, "platform": "tpu",
+                              "round": 1},
+    }))
+    traj = load_trajectory(tmp_path)
+    assert [(p.round, p.values["trees_per_sec"], p.carried)
+            for p in traj["train"]] == [(1, 8.0, False)]
+
+
+# ----------------------------------------------------------- gate logic
+def _budget(**pins):
+    return pins
+
+
+def test_regressed_training_fixture_fails_loudly(tmp_path):
+    """ACCEPTANCE: the gate is red on a regressed fixture — newest
+    chip-verified trees/s below the pinned floor."""
+    _write(tmp_path, "BENCH_r01.json", _wrap(1, {
+        "value": 10.0, "quantized_trees_per_sec": 20.0,
+        "platform": "tpu",
+    }))
+    _write(tmp_path, "BENCH_r02.json", _wrap(2, {
+        "value": 3.0, "quantized_trees_per_sec": 20.0,
+        "platform": "tpu",
+    }))
+    budget = _budget(**{
+        "train.trees_per_sec": {"min": 8.0},
+        "train.quantized_trees_per_sec": {"min": 16.0},
+    })
+    result = run_gate(tmp_path, budget)
+    assert not result.ok
+    bad = {c.name: c for c in result.checks if not c.ok}
+    assert set(bad) == {"train.trees_per_sec"}
+    assert "3.0" in bad["train.trees_per_sec"].detail
+    # within headroom -> green
+    ok = run_gate(tmp_path, _budget(**{
+        "train.trees_per_sec": {"min": 2.5},
+        "train.quantized_trees_per_sec": {"min": 16.0},
+    }))
+    assert ok.ok, ok.format()
+
+
+def test_serving_qps_and_p99_gating(tmp_path):
+    _write(tmp_path, "BENCH_SERVE_r01.json", {
+        "schema": "lightgbm-tpu/bench-serve/v1",
+        "qps": 1000.0, "p99_ms": 4.0, "platform": "tpu",
+    })
+    green = run_gate(tmp_path, _budget(**{
+        "serve.qps": {"min": 800.0},
+        "serve.p99_ms": {"max": 5.0},
+    }))
+    assert green.ok, green.format()
+    red = run_gate(tmp_path, _budget(**{
+        "serve.qps": {"min": 1200.0},
+        "serve.p99_ms": {"max": 3.0},
+    }))
+    bad = {c.name for c in red.checks if not c.ok}
+    assert bad == {"serve.qps", "serve.p99_ms"}
+
+
+def test_points_without_pin_and_pin_without_points_both_fail(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _wrap(1, {
+        "value": 10.0, "platform": "tpu",
+    }))
+    # eligible point, no pin -> "run --refresh-budgets"
+    r = run_gate(tmp_path, {})
+    bad = {c.name: c.detail for c in r.checks if not c.ok}
+    assert "train.trees_per_sec" in bad
+    assert "--refresh-budgets" in bad["train.trees_per_sec"]
+    # pin for a series whose evidence vanished -> red too
+    r2 = run_gate(tmp_path, _budget(**{
+        "train.trees_per_sec": {"min": 8.0},
+        "serve.qps": {"min": 100.0},
+    }))
+    bad2 = {c.name for c in r2.checks if not c.ok}
+    assert "serve.qps" in bad2
+    # neither points nor pin (serve.p99_ms here) -> reported, passes
+    p99 = next(c for c in r2.checks if c.name == "serve.p99_ms")
+    assert p99.ok and "unpinned" in p99.detail
+
+
+# -------------------------------------------------------------- refresh
+def test_refresh_budget_pins_with_headroom(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench_gate, "_BUDGET_PATH",
+                        tmp_path / "bench_budget.json")
+    _write(tmp_path, "BENCH_r01.json", _wrap(1, {
+        "value": 10.0, "quantized_trees_per_sec": 20.0,
+        "platform": "tpu",
+    }))
+    _write(tmp_path, "BENCH_SERVE_r01.json", {
+        "qps": 1000.0, "p99_ms": 4.0, "platform": "tpu",
+    })
+    old, new = bench_gate.refresh_budget(tmp_path)
+    assert old == {}
+    written = json.loads((tmp_path / "bench_budget.json").read_text())
+    assert written["train.trees_per_sec"]["min"] == pytest.approx(8.0)
+    assert written["train.quantized_trees_per_sec"]["min"] == \
+        pytest.approx(16.0)
+    assert written["serve.qps"]["min"] == pytest.approx(800.0)
+    assert written["serve.p99_ms"]["max"] == pytest.approx(4.8)
+    assert written["train.trees_per_sec"]["pinned_from"]["value"] == 10.0
+    diff = bench_gate.format_budget_diff(old, new)
+    assert "train.trees_per_sec.min: None -> 8.0" in diff
+    # the freshly-pinned gate is green against the same trajectory
+    assert run_gate(tmp_path, new).ok
+    # refresh keeps an existing pin when its series loses evidence
+    (tmp_path / "BENCH_SERVE_r01.json").unlink()
+    old2, new2 = bench_gate.refresh_budget(tmp_path)
+    assert new2["serve.qps"] == new["serve.qps"]
+
+
+def test_checked_in_budget_consistent_with_trajectory():
+    """Meta: bench_budget.json's pins were produced by refresh_budget
+    over the checked-in trajectory (pin = pinned_from * (1 -/+ 20%)),
+    so the file cannot drift from the refresh flow."""
+    budget = bench_gate.load_budget()
+    assert budget, "bench_budget.json missing or empty"
+    for spec in bench_gate.SERIES:
+        name = f"{spec.group}.{spec.key}"
+        pin = budget.get(name)
+        if pin is None:
+            continue
+        v = pin["pinned_from"]["value"]
+        if spec.higher_better:
+            assert pin["min"] == pytest.approx(v * 0.8, rel=1e-3)
+        else:
+            assert pin["max"] == pytest.approx(v * 1.2, rel=1e-3)
+
+
+# ---------------------------------------------------------- registration
+def test_bench_gate_registered_in_strict_passes():
+    """Satellite: the pass registry (and therefore --strict, the CLI,
+    tools/analysis.sh, and the run-every-pass meta-test) includes the
+    bench gate; it needs no jax backend."""
+    from lightgbm_tpu.analysis.passes import PASSES, run_passes
+
+    assert "bench_gate" in PASSES
+    assert PASSES["bench_gate"].needs_jax is False
+    results = run_passes(["bench_gate"])
+    assert len(results) == 1 and results[0].name == "bench_gate"
+    assert results[0].ok, results[0].report
+    assert "train.trees_per_sec" in results[0].report

@@ -218,6 +218,189 @@ def test_trace_eager_path_has_every_round_phase(rng):
         assert phase in names, f"missing per-round phase span {phase!r}"
 
 
+_VALID_PH = {"X", "B", "E", "i", "I", "C", "M", "b", "e", "n", "s", "t", "f"}
+
+
+def _validate_chrome_trace(data):
+    """Schema-validate a Chrome trace-event export: required fields per
+    phase type, numeric timestamps, and B/E begin/end events paired per
+    (pid, tid, name)."""
+    assert "traceEvents" in data
+    open_stacks = {}
+    for e in data["traceEvents"]:
+        ph = e.get("ph")
+        assert ph in _VALID_PH, f"unknown phase type {ph!r}: {e}"
+        assert e.get("name"), f"event missing name: {e}"
+        assert "pid" in e, f"event missing pid: {e}"
+        if ph != "M":  # metadata events carry no timestamp
+            assert isinstance(e.get("ts"), (int, float)), e
+            assert "tid" in e or ph == "C", f"event missing tid: {e}"
+        if ph == "X":
+            assert isinstance(e.get("dur"), (int, float)) and e["dur"] >= 0
+        if ph == "B":
+            open_stacks.setdefault((e["pid"], e["tid"]), []).append(e["name"])
+        if ph == "E":
+            stack = open_stacks.get((e["pid"], e["tid"]))
+            assert stack, f"E event without matching B: {e}"
+            stack.pop()
+    dangling = {k: v for k, v in open_stacks.items() if v}
+    assert not dangling, f"unclosed B events: {dangling}"
+
+
+def test_trace_export_schema_valid(rng, tmp_path):
+    """The full Chrome export passes trace-event schema validation
+    (required ph/ts/pid/tid/name fields, paired B/E or complete X),
+    including instant + counter + metadata events."""
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    path = tmp_path / "trace.json"
+    with tracing.tracing(chrome_path=str(path)) as rec:
+        _train({"objective": "binary", "num_leaves": 7}, X, y, rounds=2)
+        rec.add_instant("checkpoint", {"k": 1})
+        rec.add_counter("queue", {"depth": 3.0})
+    _validate_chrome_trace(json.loads(path.read_text()))
+
+
+def test_trace_validation_catches_unpaired_begin():
+    """The validator itself is red-to-green: a B without its E fails."""
+    bad = {"traceEvents": [
+        {"name": "x", "ph": "B", "ts": 0.0, "pid": 1, "tid": 1},
+    ]}
+    with pytest.raises(AssertionError, match="unclosed B"):
+        _validate_chrome_trace(bad)
+
+
+# -------------------------------------------------------------- aggregate
+def test_two_registry_snapshot_merge():
+    """ACCEPTANCE: two independent registries (the two-process stand-in
+    on the collective-less CPU backend) merge host-side — counters sum,
+    gauges sum with min/max spread, no jax collective anywhere."""
+    from lightgbm_tpu.obs import aggregate
+
+    r1 = MetricsRegistry(enabled=True)
+    r2 = MetricsRegistry(enabled=True)
+    for i, r in enumerate((r1, r2)):
+        r.counter("fleet_rounds_total", "rounds", labels=("entry",)).inc(
+            10 * (i + 1), entry="train")
+        r.gauge("fleet_trees_per_sec", "tps").set(5.0 * (i + 1))
+    snaps = [
+        aggregate.snapshot_dict(r, process=i)
+        for i, r in enumerate((r1, r2))
+    ]
+    merged = aggregate.merge(snaps)
+    assert merged["processes"] == 2
+    ctr = merged["metrics"]["fleet_rounds_total"]
+    assert ctr["values"]['{entry="train"}'] == 30.0
+    assert "min" not in ctr  # counters are additive, no spread
+    g = merged["metrics"]["fleet_trees_per_sec"]
+    assert g["values"][""] == 15.0  # fleet throughput = sum
+    assert g["min"][""] == 5.0 and g["max"][""] == 10.0
+
+
+def test_snapshot_file_roundtrip_and_merge(tmp_path):
+    from lightgbm_tpu.obs import aggregate
+
+    r1 = MetricsRegistry(enabled=True)
+    r1.counter("c_total").inc(3)
+    p1 = tmp_path / "metrics_rank00000.json"
+    aggregate.write_snapshot(str(p1), r1, process=0)
+    snap = aggregate.read_snapshot(str(p1))
+    assert snap["metrics"]["c_total"]["kind"] == "counter"
+    merged = aggregate.merge_files([str(p1)])
+    assert merged["metrics"]["c_total"]["values"][""] == 3.0
+    # a non-snapshot json is rejected loudly
+    bad = tmp_path / "other.json"
+    bad.write_text("{}")
+    with pytest.raises(ValueError, match="not a metrics snapshot"):
+        aggregate.read_snapshot(str(bad))
+
+
+@pytest.mark.slow
+def test_prometheus_parse_and_http_pull_merge(rng):
+    """Fleet aggregation's HTTP leg: scrape two /metrics bodies (one
+    live worker endpoint + one rendered registry) and merge them —
+    exactly what a multi-replica serving fleet view does."""
+    from lightgbm_tpu.obs import aggregate
+    from lightgbm_tpu.serving import ModelRegistry, serve_http
+
+    X = rng.randn(400, 4)
+    bst = _train({"objective": "regression", "num_leaves": 7},
+                 X, X[:, 0])
+    reg = ModelRegistry()
+    reg.load("agg", bst)
+    reg.predict("agg", X[:16].astype(np.float32))
+    httpd = serve_http(reg, port=0, block=False)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        pulled = aggregate.pull_snapshot(url, process=0)
+        assert any(
+            name.startswith("lgbmtpu_") for name in pulled["metrics"]
+        )
+        # parse a rendered exposition as the "second worker"
+        local = aggregate.parse_prometheus(
+            default_registry().render_prometheus(), process=1
+        )
+        merged = aggregate.merge([pulled, local])
+        assert merged["processes"] == 2
+        # the pulled sample and the local sample describe the same
+        # registry here, so the merged counter is exactly double
+        name = "lgbmtpu_serve_rows_total"
+        key = '{entry="serve:agg"}'
+        assert merged["metrics"][name]["values"][key] == \
+            2 * pulled["metrics"][name]["values"][key]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_multihost_fleet_snapshot_files(tmp_path):
+    """parallel.multihost's fleet helpers: write this process's
+    snapshot, merge the directory — file-based, no collectives."""
+    from lightgbm_tpu.obs.metrics import default_registry
+    from lightgbm_tpu.parallel.multihost import (
+        merged_fleet_snapshot,
+        write_metrics_snapshot,
+    )
+
+    default_registry().counter("fleet_probe_total").inc(2)
+    path = write_metrics_snapshot(str(tmp_path))
+    assert Path(path).name == "metrics_rank00000.json"
+    merged = merged_fleet_snapshot(str(tmp_path))
+    assert merged["metrics"]["fleet_probe_total"]["values"][""] >= 2.0
+    with pytest.raises(FileNotFoundError):
+        merged_fleet_snapshot(str(tmp_path / "empty"))
+
+
+def test_obs_report_renders(tmp_path, capsys):
+    """tools/obs_report.py renders snapshots + recorder streams."""
+    import importlib.util as ilu
+
+    from lightgbm_tpu.obs import aggregate
+
+    r = MetricsRegistry(enabled=True)
+    r.counter("c_total").inc(1)
+    snap = tmp_path / "metrics_rank00000.json"
+    aggregate.write_snapshot(str(snap), r, process=0)
+    rec = tmp_path / "run.jsonl"
+    rec.write_text(
+        json.dumps({"schema": "lightgbm-tpu/flight-record/v1"}) + "\n"
+        + json.dumps({"round": 0, "evals": {"v l2": 1.0},
+                      "trees_per_sec": 2.0}) + "\n"
+    )
+    spec = ilu.spec_from_file_location(
+        "obs_report", REPO / "tools" / "obs_report.py"
+    )
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--snapshots", str(snap), "--recorder", str(rec)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "fleet metrics" in out and "c_total" in out
+    assert "flight record" in out and "round 0" in out
+
+
 # ---------------------------------------------------------------- manifest
 def test_run_manifest_schema_and_static_wire_budget(rng, tmp_path):
     from lightgbm_tpu.config import Config
@@ -284,7 +467,8 @@ def test_obs_modules_in_analysis_scan():
     files, root = iter_package_modules()
     rel = {p.relative_to(root).as_posix() for p in files}
     for mod in ("obs/__init__.py", "obs/metrics.py", "obs/tracing.py",
-                "obs/manifest.py"):
+                "obs/manifest.py", "obs/recorder.py", "obs/anomaly.py",
+                "obs/aggregate.py"):
         assert mod in rel, f"{mod} escaped the analysis scan"
 
 
@@ -391,6 +575,66 @@ def test_bench_serve_writes_artifact(tmp_path, monkeypatch):
         assert key in data and data[key] >= 0
     assert data["requests"] == 8
     assert data["stats"].get("count", 0) >= 1
+
+
+@pytest.mark.slow
+def test_bench_serve_provenance_and_carry_forward(tmp_path, monkeypatch):
+    """Satellite: bench_serve stamps run_id + run-manifest path into
+    its artifact and carries last_tpu_verified with bench.py's stale
+    semantics (off-chip run -> stale: true, ignored by the gate)."""
+    for k, v in (("BENCH_SERVE_DIR", str(tmp_path)),
+                 ("BENCH_SERVE_TRAIN_ROWS", "400"),
+                 ("BENCH_SERVE_FEATURES", "4"),
+                 ("BENCH_SERVE_TREES", "3"), ("BENCH_SERVE_LEAVES", "7"),
+                 ("BENCH_SERVE_REQUESTS", "4"),
+                 ("BENCH_SERVE_BATCH", "8"),
+                 ("BENCH_SERVE_THREADS", "1")):
+        monkeypatch.setenv(k, v)
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve_prov", REPO / "bench_serve.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.LAST_TPU_VERIFIED = {
+        "qps": 5000.0, "p99_ms": 1.0, "platform": "tpu", "round": 9,
+    }
+    assert mod.main() == 0
+    artifact = next(tmp_path.glob("BENCH_SERVE_r*.json"))
+    data = json.loads(artifact.read_text())
+    assert data["run_id"]
+    mpath = Path(data["run_manifest"])
+    assert mpath.name.startswith("run_manifest_serve_r")
+    manifest = json.loads(mpath.read_text())
+    assert manifest["extra"]["run_id"] == data["run_id"]
+    assert manifest["extra"]["artifact"] == str(artifact)
+    # this run ran off-chip -> the carried chip numbers are stale
+    assert data["platform"] != "tpu"
+    assert data["last_tpu_verified"]["stale"] is True
+    # ...and therefore contribute NOTHING to the gate's trajectory
+    from lightgbm_tpu.analysis.bench_gate import load_trajectory
+
+    assert load_trajectory(tmp_path)["serve"] == []
+
+
+def test_bench_train_manifest_stamp(tmp_path, monkeypatch):
+    """bench.py's provenance hook: run manifest written, path + run id
+    folded into the partial state the final JSON reports."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_prov", REPO / "bench.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setenv("BENCH_MANIFEST_OUT",
+                       str(tmp_path / "manifest.json"))
+    mod._STATE["run_id"] = "test-run"
+    mod.write_run_manifest({"objective": "binary", "num_leaves": 7})
+    assert mod._STATE["run_manifest"] == str(tmp_path / "manifest.json")
+    m = json.loads((tmp_path / "manifest.json").read_text())
+    assert m["extra"]["run_id"] == "test-run"
+    assert m["config"]["explicit"]["objective"] == "binary"
+    out = mod._final_json()
+    assert out["run_id"] == "test-run"
+    assert out["run_manifest"] == str(tmp_path / "manifest.json")
 
 
 # --------------------------------------------------------------- profile

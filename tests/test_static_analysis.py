@@ -405,3 +405,10 @@ def test_strict_equivalent_in_process():
     results = run_audits()
     bad = [r.format() for r in results if not r.ok]
     assert not bad, "\n".join(bad)
+    # the bench-trajectory gate (Pass 6) runs in tier-1 too: cheap
+    # JSON parsing, and a regressed checked-in BENCH point must fail
+    # the suite just like a lint violation would
+    from lightgbm_tpu.analysis.bench_gate import run_gate
+
+    gate = run_gate()
+    assert gate.ok, gate.format()

@@ -1,13 +1,14 @@
 #!/usr/bin/env bash
 # CI wiring for the static analysis suite (docs/STATIC_ANALYSIS.md):
 # trace-safety lint, serving concurrency lint, jaxpr invariant audits,
-# and the XLA cost/memory + collective wire-bytes audits — every pass
-# registered in analysis/passes.py. Strict mode: any unsuppressed
-# finding or failed contract/budget exits nonzero.
+# the XLA cost/memory + collective wire-bytes audits, and the
+# BENCH-trajectory regression gate — every pass registered in
+# analysis/passes.py. Strict mode: any unsuppressed finding or failed
+# contract/budget/trajectory pin exits nonzero.
 #
 # Budget maintenance (run + review + commit the diff):
 #   tools/analysis.sh --update-budget     # jaxpr_budget.json
-#   tools/analysis.sh --refresh-budgets   # cost_budget.json (+ diff)
+#   tools/analysis.sh --refresh-budgets   # cost_budget.json + bench_budget.json (+ diffs)
 #
 # The python entry point forces jax onto a cpu 8-device mesh itself, so
 # this is safe on hosts whose ambient JAX_PLATFORMS points at real
