@@ -38,6 +38,7 @@ BENCH_FORCE_CPU, BENCH_CPU_ROWS, BENCH_GROWTH_MODE,
 BENCH_BUDGET (s, SIGALRM deadline), BENCH_RUN_DIR (partial-state dir).
 """
 
+import importlib.util
 import json
 import os
 import signal
@@ -49,6 +50,21 @@ import time
 import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_backoff():
+    """Load resilience/backoff.py by FILE PATH: the bench must not
+    import the lightgbm_tpu package (that pulls in jax) before the
+    subprocess backend probe, and backoff.py is pure stdlib by design
+    (docs/RESILIENCE.md)."""
+    path = os.path.join(REPO, "lightgbm_tpu", "resilience", "backoff.py")
+    spec = importlib.util.spec_from_file_location("_bench_backoff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+backoff_delay = _load_backoff().backoff_delay
 
 
 def _partial_path() -> str:
@@ -124,7 +140,9 @@ def probe_backend(timeout_s: float, retries: int = 1) -> str:
                 f"[bench] backend probe {attempt}/{retries} failed: {e}\n"
             )
         if attempt < retries:
-            backoff = min(10.0 * (2 ** (attempt - 1)), 120.0)
+            # shared backoff schedule (resilience/backoff.py) — one
+            # implementation for bench probe, fleet scrape, cluster join
+            backoff = backoff_delay(attempt, base_s=10.0, cap_s=120.0)
             sys.stderr.write(f"[bench] retrying probe in {backoff:.0f}s\n")
             time.sleep(backoff)
     return "cpu"

@@ -525,6 +525,76 @@ def audit_fold_attrs() -> AuditResult:
     )
 
 
+# -------------------------------------------------- fault-injection audit
+def audit_faultinject() -> AuditResult:
+    """Fault injection must cost nothing when disarmed and stay
+    invisible to traced code when armed (docs/RESILIENCE.md):
+
+    1. pure-AST: every ``fault_point()`` call site lives in a
+       whitelisted HOST-side module (engine loop, serving dispatcher /
+       transport) — a call in kernel or traced code would bake a host
+       callback (or a retrace) into the hot path;
+    2. trace proof: building the serving entry with a fault plan ARMED
+       (cache bypassed) yields a jaxpr with the identical equation
+       count and no host callbacks — arming adds zero device work.
+    """
+    import ast
+
+    from ..resilience import faultinject as _fi
+
+    pkg_root = Path(__file__).resolve().parents[1]
+    allowed = {
+        "resilience/faultinject.py",  # the definition itself
+        "engine.py",                  # per-round host loop
+        "serving/dispatch.py",        # host side of the device call
+        "serving/server.py",          # request transport
+    }
+    sites: List[str] = []
+    offenders: List[str] = []
+    for py in sorted(pkg_root.rglob("*.py")):
+        rel = py.relative_to(pkg_root).as_posix()
+        src = py.read_text()
+        if "fault_point" not in src:
+            continue
+        for n in ast.walk(ast.parse(src)):
+            if isinstance(n, ast.Call):
+                f = n.func
+                fname = (f.attr if isinstance(f, ast.Attribute)
+                         else getattr(f, "id", ""))
+                if fname == "fault_point":
+                    sites.append(f"{rel}:{n.lineno}")
+                    if rel not in allowed:
+                        offenders.append(f"{rel}:{n.lineno}")
+    c_sites = Contract(
+        "fault_sites_host_only", not offenders,
+        f"{len(sites)} fault_point site(s) all in host-side modules "
+        f"{sorted(allowed)}" if not offenders else
+        "fault_point called outside the host-side whitelist (would "
+        "put a fault hook into traced/kernel code): "
+        + ", ".join(offenders),
+    )
+
+    baseline = summarize(build_entry("serving_forest"))
+    prev_plan = _fi._PLAN
+    _fi.arm("device_put:999999:raise;serve_request:999999:raise")
+    try:
+        armed = summarize(ENTRIES["serving_forest"].builder())
+    finally:
+        _fi._PLAN = prev_plan  # restore whatever the caller had armed
+    c_eqns = Contract(
+        "armed_trace_identical", armed.eqn_count == baseline.eqn_count,
+        f"serving trace has {armed.eqn_count} eqns armed vs "
+        f"{baseline.eqn_count} disarmed"
+        + ("" if armed.eqn_count == baseline.eqn_count else
+           " — an armed fault plan must not change the traced program"),
+    )
+    c_cb = no_host_callbacks()(armed)
+    ok = all(c.ok for c in (c_sites, c_eqns, c_cb))
+    return AuditResult(
+        "faultinject", ok, [c_sites, c_eqns, c_cb], armed.eqn_count
+    )
+
+
 # ------------------------------------------------------------------ runner
 # entry traces are pure functions of checked-in shapes, and the strict
 # gate reads each one at least twice (jaxpr pass + cost pass, several
@@ -574,7 +644,8 @@ def load_budgets() -> Dict[str, int]:
 def run_audits(names: Optional[Sequence[str]] = None,
                update_budget: bool = False) -> List[AuditResult]:
     if names is not None:
-        unknown = set(names) - set(ENTRIES) - {"obj_fold_attrs"}
+        unknown = set(names) - set(ENTRIES) - {"obj_fold_attrs",
+                                               "faultinject"}
         if unknown:
             # a typoed entry name must not pass vacuously ("no silent
             # caps" — same posture as within_budget failing on a
@@ -582,7 +653,7 @@ def run_audits(names: Optional[Sequence[str]] = None,
             raise KeyError(
                 f"unknown audit entr{'y' if len(unknown) == 1 else 'ies'} "
                 f"{sorted(unknown)}; known: "
-                f"{sorted(ENTRIES) + ['obj_fold_attrs']}"
+                f"{sorted(ENTRIES) + ['faultinject', 'obj_fold_attrs']}"
             )
     budgets = load_budgets()
     out: List[AuditResult] = []
@@ -601,6 +672,8 @@ def run_audits(names: Optional[Sequence[str]] = None,
         ))
     if names is None or "obj_fold_attrs" in (names or ()):
         out.append(audit_fold_attrs())
+    if names is None or "faultinject" in (names or ()):
+        out.append(audit_faultinject())
     if update_budget:
         _BUDGET_PATH.write_text(
             json.dumps(new_budgets, indent=2, sort_keys=True) + "\n"

@@ -2109,7 +2109,6 @@ class DART(GBDT):
         super().__init__(config, train_set)
         self._force_sync = True  # dropout mutates past trees every iter
         self._force_sync_reason = "DART dropout mutates past trees every iteration"
-        self._drop_rng = np.random.RandomState(config.drop_seed)
         self._tree_weight: List[float] = []  # per-iteration weights
         self._sum_weight = 0.0
         self._pending_drops: Optional[List[int]] = None
@@ -2126,7 +2125,15 @@ class DART(GBDT):
 
     def _select_drops(self) -> List[int]:
         c = self.config
-        if self._drop_rng.rand() < c.skip_drop or self.iter_ == 0:
+        # drop decisions are a pure function of (drop_seed, iter_), not
+        # of a sequential stream: a crash-resumed process has consumed
+        # zero draws, so stream position can never survive a restart —
+        # per-iteration keying is what makes DART resume deterministic
+        # (mirrors the fold_in(seed, iter) keying of bagging RNG)
+        rng = np.random.RandomState(
+            (int(c.drop_seed) * 2654435761 + self.iter_) % (2 ** 32)
+        )
+        if rng.rand() < c.skip_drop or self.iter_ == 0:
             return []
         drops: List[int] = []
         if not c.uniform_drop:
@@ -2135,7 +2142,7 @@ class DART(GBDT):
             if c.max_drop > 0:
                 rate = min(rate, c.max_drop * inv_avg / max(self._sum_weight, 1e-300))
             for i in range(self.iter_):
-                if self._drop_rng.rand() < rate * self._tree_weight[i] * inv_avg:
+                if rng.rand() < rate * self._tree_weight[i] * inv_avg:
                     drops.append(i)
                     if len(drops) >= c.max_drop > 0:
                         break
@@ -2144,7 +2151,7 @@ class DART(GBDT):
             if c.max_drop > 0:
                 rate = min(rate, c.max_drop / max(1, self.iter_))
             for i in range(self.iter_):
-                if self._drop_rng.rand() < rate:
+                if rng.rand() < rate:
                     drops.append(i)
                     if len(drops) >= c.max_drop > 0:
                         break

@@ -320,8 +320,15 @@ def _task_serve(params: Dict[str, str]) -> None:
             log.info(
                 f"serving rows sharded over {jax.device_count()} devices"
             )
+        # chaos testing: a fault plan from config/env arms the
+        # serve_request / device_put sites (docs/RESILIENCE.md)
+        from .resilience import faultinject
+
+        faultinject.configure(cfg.fault_plan)
         registry = ModelRegistry(
-            mesh=mesh, buckets=cfg.serve_buckets, warmup=cfg.serve_warmup
+            mesh=mesh, buckets=cfg.serve_buckets, warmup=cfg.serve_warmup,
+            deadline_s=cfg.serve_deadline_ms / 1000.0,
+            queue_cap=cfg.serve_queue_cap,
         )
         registry.load(cfg.serve_model_name, model_path)
         if cfg.serve_port > 0:

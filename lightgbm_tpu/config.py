@@ -219,6 +219,12 @@ _PARAMS: Dict[str, _P] = {
     "serve_buckets": (DEFAULT_SERVE_BUCKETS, "list_int", (), None),
     "serve_warmup": (True, bool, (), None),  # precompile every bucket
     "serve_model_name": ("default", str, (), None),
+    # serving degradation knobs (docs/RESILIENCE.md): default deadline
+    # applied to queued (via_queue) scoring requests, 0 = none; row cap
+    # on the microbatch queue, 0 = unbounded (over-cap submits fast-fail
+    # with QueueOverflow -> HTTP 503 + Retry-After)
+    "serve_deadline_ms": (0.0, float, (), _nonneg),
+    "serve_queue_cap": (0, int, (), _nonneg),
     # ---- observability (lightgbm_tpu/obs, docs/OBSERVABILITY.md) ----
     # runtime switch for the phase timer (the env LIGHTGBM_TPU_TIMETAG
     # analog of the reference's compile-time USE_TIMETAG) — no restart
@@ -237,9 +243,30 @@ _PARAMS: Dict[str, _P] = {
     # anomaly sentinels over the flight-record stream
     # (obs/anomaly.py): off = sentinels don't run; warn = log + metrics
     # counter + trace instant per trip; abort = additionally raise
-    # AnomalyAbort (the recorder and manifest still flush)
+    # AnomalyAbort (the recorder and manifest still flush); rollback =
+    # restore the last snapshot_freq checkpoint and retrain (optionally
+    # with a shrunken learning_rate) instead of aborting
     "anomaly_policy": ("off", str, (),
-                       lambda v: v in ("off", "warn", "abort")),
+                       lambda v: v in ("off", "warn", "abort", "rollback")),
+    # ---- resilience (lightgbm_tpu/resilience, docs/RESILIENCE.md) ----
+    # crash-consistent checkpoint/resume: snapshot_freq>0 additionally
+    # maintains ONE rolling checkpoint (model text + round index + eval
+    # history + flight-record offset, written atomically). resume=auto
+    # restarts train() from it when present; resume_from= names an
+    # explicit checkpoint file (missing -> error). The resumed model
+    # bit-matches the uninterrupted run.
+    "resume": ("off", str, (), lambda v: v in ("off", "auto")),
+    "resume_from": ("", str, (), None),
+    # rolling checkpoint path; empty = <output_model>.ckpt
+    "checkpoint_file": ("", str, (), None),
+    # anomaly_policy=rollback: learning_rate multiplier applied on each
+    # rollback retrain, and how many rollbacks before giving up
+    "anomaly_rollback_lr_decay": (1.0, float, (), _pos),
+    "anomaly_rollback_max": (2, int, (), _nonneg),
+    # deterministic fault plan (resilience/faultinject.py), e.g.
+    # "round:7:kill;serve_request:2:delay:0.25"; empty = env
+    # LGBMTPU_FAULT_PLAN, else disarmed (zero overhead)
+    "fault_plan": ("", str, (), None),
 }
 
 # alias -> canonical name

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
@@ -14,6 +15,10 @@ from . import log
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .config import Config, resolve_alias
+from .obs.anomaly import AnomalyAbort
+from .resilience import checkpoint as ckpt_mod
+from .resilience import faultinject
+from .resilience.faultinject import fault_point
 
 
 def _resolve_num_boost_round(params: Dict[str, Any], num_boost_round: int) -> Tuple[Dict, int]:
@@ -40,6 +45,10 @@ class _ObsHooks:
     def __init__(self, recorder, sentinel):
         self.recorder = recorder
         self.sentinel = sentinel
+        # records carry ABSOLUTE round indices so a resumed run's
+        # truncate+append stream stays monotonic (engine sets this to
+        # the checkpoint round on resume)
+        self.round_offset = 0
         self._gbdt = None
         self._chunk_tps: Optional[float] = None
         self._step_durs: List[float] = []
@@ -106,7 +115,9 @@ class _ObsHooks:
     def fused_round(self, i: int, j: int, evals) -> None:
         from .boosting import FUSED_ROUND_PHASE
 
-        rec: Dict[str, Any] = {"round": i, "t_unix": time.time()}
+        rec: Dict[str, Any] = {
+            "round": self.round_offset + i, "t_unix": time.time()
+        }
         if j < len(self._step_durs):
             rec["phases"] = {
                 FUSED_ROUND_PHASE: round(self._step_durs[j], 6)
@@ -127,7 +138,9 @@ class _ObsHooks:
         self._emit(rec)
 
     def eager_round(self, i: int, evals, iter_seconds: float) -> None:
-        rec: Dict[str, Any] = {"round": i, "t_unix": time.time()}
+        rec: Dict[str, Any] = {
+            "round": self.round_offset + i, "t_unix": time.time()
+        }
         drained = self.recorder.drain_phases()
         if drained:
             rec["phases"] = {
@@ -157,9 +170,13 @@ class _ObsHooks:
         self.recorder.close()
 
 
-def _make_obs_hooks(cfg) -> Optional[_ObsHooks]:
+def _make_obs_hooks(cfg, resume_bytes: Optional[int] = None
+                    ) -> Optional[_ObsHooks]:
     """record_file / anomaly_policy config -> hooks (None = both off,
-    the default: zero per-round overhead)."""
+    the default: zero per-round overhead). ``resume_bytes`` is the
+    checkpoint's captured record-stream offset: the recorder truncates
+    the stream back to it and appends, so a resumed run's flight
+    record carries each round exactly once."""
     path = cfg.record_file
     policy = cfg.anomaly_policy
     if not path and policy == "off":
@@ -167,7 +184,7 @@ def _make_obs_hooks(cfg) -> Optional[_ObsHooks]:
     from .obs.anomaly import make_sentinel
     from .obs.recorder import FlightRecorder
 
-    recorder = FlightRecorder(path or None)
+    recorder = FlightRecorder(path or None, resume_bytes=resume_bytes)
     sentinel = make_sentinel(policy, recorder=recorder)
     return _ObsHooks(recorder, sentinel)
 
@@ -195,6 +212,13 @@ def train(
         enable_timetag()
     if cfg_probe.objective == "none" and fobj is None:
         log.warning("Using custom objective requires fobj; objective=none trains nothing")
+    # deterministic fault plans (fault_plan param / LGBMTPU_FAULT_PLAN
+    # env, docs/RESILIENCE.md); disarmed = a single None check per round
+    faultinject.configure(cfg_probe.fault_plan)
+    # the raw caller-supplied callbacks, before ES/logging are appended:
+    # an anomaly_policy=rollback retry must re-run train() with these
+    # (the appended callbacks hold consumed state and would double up)
+    user_callbacks = list(callbacks) if callbacks else []
     # early stopping via params (engine.py behavior)
     callbacks = list(callbacks) if callbacks else []
     if cfg_probe.early_stopping_round and cfg_probe.early_stopping_round > 0:
@@ -210,6 +234,43 @@ def train(
         for cb in callbacks
     ):
         callbacks.append(callback_mod.log_evaluation(period=cfg_probe.metric_freq))
+
+    # ---- crash-consistent resume (docs/RESILIENCE.md). A checkpoint
+    # is adopted exactly like a user init_model: the model text rides
+    # _continue_from, and because every sampling key is derived from
+    # the ABSOLUTE iteration (boosting.py fold_in(seed, iteration)), a
+    # resumed run replays the identical tree sequence — the final model
+    # bit-matches an uninterrupted run (tests/test_resilience.py).
+    ckpt_path = cfg_probe.checkpoint_file or ckpt_mod.default_path(
+        cfg_probe.output_model
+    )
+    resume_offset = 0
+    resume_rows: List[List[Tuple]] = []
+    record_resume_bytes: Optional[int] = None
+    if init_model is None and (cfg_probe.resume == "auto"
+                               or cfg_probe.resume_from):
+        found, state = ckpt_mod.find_resume_checkpoint(
+            cfg_probe.resume, cfg_probe.resume_from, ckpt_path
+        )
+        if state is not None:
+            fp = ckpt_mod.config_fingerprint(params)
+            if state.get("fingerprint") and state["fingerprint"] != fp:
+                log.warning(
+                    f"Checkpoint {found} was written under a different "
+                    f"training config (fingerprint {state['fingerprint']}"
+                    f" != {fp}); resuming anyway — the combined model "
+                    "will not bit-match a single uninterrupted run"
+                )
+            init_model = Booster(model_str=state["model"])
+            resume_offset = state["engine_round"]
+            resume_rows = ckpt_mod.truncate_eval_history(
+                state.get("eval_history", ()), resume_offset
+            )
+            record_resume_bytes = state.get("record_offset")
+            log.info(
+                f"Resuming training from checkpoint {found} "
+                f"(round {resume_offset})"
+            )
 
     booster = Booster(params=params, train_set=train_set)
     valid_sets = valid_sets or []
@@ -236,23 +297,63 @@ def train(
     cb_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cb_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
-    snapshot_freq = cfg_probe.snapshot_freq
+    # rounds are ABSOLUTE across resume: a checkpoint at round R leaves
+    # `num_boost_round - R` rounds to run, and every callback / fault
+    # site / snapshot sees `resume_offset + i` so the resumed half is
+    # indistinguishable from the tail of an uninterrupted run
+    total_rounds = num_boost_round
+    num_boost_round = max(total_rounds - resume_offset, 0)
 
-    def _snapshot(done_iter: int) -> None:
-        """snapshot_freq model dumps during training (gbdt.cpp:258-262)."""
-        if snapshot_freq > 0 and (done_iter + 1) % snapshot_freq == 0:
-            out = f"{cfg_probe.output_model}.snapshot_iter_{done_iter + 1}"
-            # clamp explicitly (the fused path materializes whole chunks
-            # before callbacks replay); done_iter counts NEW iterations —
-            # offset by any init_model trees so snapshots keep them
-            total = booster._gbdt._init_iters + done_iter + 1
-            booster.save_model(out, num_iteration=total)
-            log.info(f"Saved snapshot to {out}")
+    snapshot_freq = cfg_probe.snapshot_freq
+    ckpt_fingerprint = (
+        ckpt_mod.config_fingerprint(params) if snapshot_freq > 0 else ""
+    )
+    # eval history through the current round rides in the checkpoint so
+    # a resume can replay it into the stateful callbacks (early
+    # stopping, record_evaluation) before new rounds run
+    eval_history: List[List[Tuple]] = [list(r) for r in resume_rows]
+
+    def _snapshot(done_iter: int, evals) -> None:
+        """snapshot_freq model dumps during training (gbdt.cpp:258-262)
+        plus the crash-consistent training checkpoint (resume=auto)."""
+        if snapshot_freq <= 0:
+            return
+        abs_round = resume_offset + done_iter + 1
+        # truncate-and-set keeps the history exactly `abs_round` rows
+        eval_history[abs_round - 1:] = [[tuple(t) for t in (evals or [])]]
+        if abs_round % snapshot_freq != 0:
+            return
+        out = f"{cfg_probe.output_model}.snapshot_iter_{abs_round}"
+        # clamp explicitly (the fused path materializes whole chunks
+        # before callbacks replay); done_iter counts NEW iterations —
+        # offset by any init_model trees so snapshots keep them
+        total = booster._gbdt._init_iters + done_iter + 1
+        booster.save_model(out, num_iteration=total)
+        log.info(f"Saved snapshot to {out}")
+        record_offset = None
+        if obs_hooks is not None and obs_hooks.recorder.path:
+            # the round's record is written+flushed before _snapshot
+            # runs, so the captured size covers rounds <= abs_round —
+            # a resume truncates the stream back to exactly here
+            try:
+                record_offset = os.path.getsize(obs_hooks.recorder.path)
+            except OSError:
+                record_offset = None
+        ckpt_mod.save_checkpoint(
+            ckpt_path,
+            booster.model_to_string(num_iteration=total),
+            engine_round=abs_round,
+            total_iters=total,
+            eval_history=eval_history,
+            record_offset=record_offset,
+            fingerprint=ckpt_fingerprint,
+        )
 
     # flight recorder + anomaly sentinels (record_file / anomaly_policy
     # params, docs/OBSERVABILITY.md); None when both are off
-    obs_hooks = _make_obs_hooks(cfg_probe)
+    obs_hooks = _make_obs_hooks(cfg_probe, record_resume_bytes)
     if obs_hooks is not None:
+        obs_hooks.round_offset = resume_offset
         obs_hooks.bind(booster._gbdt)
     else:
         # an unrecorded run supersedes any earlier recorded run: a
@@ -262,8 +363,30 @@ def train(
 
         clear_last_summary()
 
-    evaluation_result_list: List[Tuple] = []
+    evaluation_result_list: List[Tuple] = (
+        list(resume_rows[-1]) if resume_rows else []
+    )
     i = -1
+    if resume_offset > 0 and resume_rows:
+        # replay the checkpointed learning curve into the STATEFUL
+        # post-iteration callbacks (order >= 20: record_evaluation,
+        # early_stopping) so their internal state matches an
+        # uninterrupted run; log_evaluation (order 10) is skipped —
+        # those rounds were already printed by the crashed run
+        replay_cbs = [
+            cb for cb in cb_after if getattr(cb, "order", 0) >= 20
+        ]
+        try:
+            for r, row in enumerate(resume_rows):
+                for cb in replay_cbs:
+                    cb(CallbackEnv(booster, params, r, 0, total_rounds,
+                                   list(row)))
+        except EarlyStopException as e:
+            # the crashed run would have stopped inside the
+            # checkpointed prefix — nothing left to train
+            booster.best_iteration = e.best_iteration + 1
+            evaluation_result_list = e.best_score
+            num_boost_round = 0
     use_fused = (
         fobj is None
         and feval is None
@@ -320,14 +443,17 @@ def train(
                     )
                 for j, evals in enumerate(records):
                     i = done + j
+                    fault_point("round", resume_offset + i)
                     evaluation_result_list = evals
                     record_eval_values(evals)
                     if obs_hooks is not None:
                         obs_hooks.fused_round(i, j, evals)
-                    _snapshot(i)
+                    _snapshot(i, evals)
                     try:
                         for cb in cb_after:
-                            cb(CallbackEnv(booster, params, i, 0, num_boost_round, evals))
+                            cb(CallbackEnv(booster, params,
+                                           resume_offset + i, 0,
+                                           total_rounds, evals))
                     except EarlyStopException as e:
                         booster.best_iteration = e.best_iteration + 1
                         evaluation_result_list = e.best_score
@@ -343,8 +469,10 @@ def train(
                     if not stop and done < num_boost_round:
                         try:
                             for cb in cb_after:
-                                cb(CallbackEnv(booster, params, done, 0,
-                                               num_boost_round, evaluation_result_list))
+                                cb(CallbackEnv(booster, params,
+                                               resume_offset + done, 0,
+                                               total_rounds,
+                                               evaluation_result_list))
                         except EarlyStopException as e:
                             booster.best_iteration = e.best_iteration + 1
                             evaluation_result_list = e.best_score
@@ -353,8 +481,10 @@ def train(
             from .obs.metrics import record_eval_values, record_training_round
 
             for i in range(num_boost_round):
+                fault_point("round", resume_offset + i)
                 for cb in cb_before:
-                    cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
+                    cb(CallbackEnv(booster, params, resume_offset + i, 0,
+                                   total_rounds, None))
                 t_iter = time.perf_counter()
                 finished = booster.update(fobj=fobj)
                 record_training_round(
@@ -372,10 +502,12 @@ def train(
                         i, evaluation_result_list,
                         time.perf_counter() - t_iter,
                     )
-                _snapshot(i)
+                _snapshot(i, evaluation_result_list)
                 try:
                     for cb in cb_after:
-                        cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
+                        cb(CallbackEnv(booster, params, resume_offset + i,
+                                       0, total_rounds,
+                                       evaluation_result_list))
                 except EarlyStopException as e:
                     booster.best_iteration = e.best_iteration + 1
                     evaluation_result_list = e.best_score
@@ -383,6 +515,48 @@ def train(
                 if finished:
                     break
 
+    except AnomalyAbort as anomaly:
+        # anomaly_policy=rollback: restore the last good checkpoint and
+        # retrain instead of discarding the run (docs/RESILIENCE.md
+        # "Recovery policies"). The budget (anomaly_rollback_max)
+        # decrements through the retry params so a deterministic
+        # re-trip terminates; without a checkpoint it degrades to abort.
+        if (cfg_probe.anomaly_policy == "rollback"
+                and snapshot_freq > 0
+                and cfg_probe.anomaly_rollback_max > 0
+                and os.path.exists(ckpt_path)):
+            if obs_hooks is not None:
+                # flush/close now: the retry reopens the record stream
+                # (truncate+append) and publishes its own summary
+                obs_hooks.close()
+                obs_hooks = None
+            retry_params = copy.deepcopy(params)
+            for k in list(retry_params):
+                if resolve_alias(k) in (
+                    "learning_rate", "resume", "resume_from",
+                    "anomaly_rollback_max",
+                ):
+                    retry_params.pop(k)
+            decay = cfg_probe.anomaly_rollback_lr_decay
+            retry_params["learning_rate"] = cfg_probe.learning_rate * decay
+            retry_params["resume_from"] = ckpt_path
+            retry_params["anomaly_rollback_max"] = (
+                cfg_probe.anomaly_rollback_max - 1
+            )
+            log.warning(
+                f"anomaly rollback: {anomaly} — restoring checkpoint "
+                f"{ckpt_path} and retraining with learning_rate="
+                f"{retry_params['learning_rate']:g} "
+                f"({cfg_probe.anomaly_rollback_max - 1} rollback(s) left)"
+            )
+            return train(
+                retry_params, train_set, total_rounds,
+                valid_sets=valid_sets, valid_names=valid_names,
+                feval=feval, init_model=None,
+                keep_training_booster=keep_training_booster,
+                callbacks=user_callbacks, fobj=fobj,
+            )
+        raise
     finally:
         # exception-safe flush (anomaly abort, callback errors,
         # KeyboardInterrupt): detach the span sink and close the
@@ -399,7 +573,7 @@ def train(
     n_iters = booster._gbdt.num_trees() // booster._gbdt.num_class
     if booster.best_iteration > n_iters:
         booster.best_iteration = n_iters
-    if n_iters < i + 1:
+    if n_iters < booster._gbdt._init_iters + i + 1:
         # truncation rolled back the blindly-trained iterations whose
         # scores produced the last eval — don't record stale values
         evaluation_result_list = []

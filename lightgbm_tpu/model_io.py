@@ -143,8 +143,15 @@ def save_model_string(
         buf.write(s)
     buf.write("end of trees\n")
 
-    # feature importances (split counts), sorted desc (gbdt_model_text.cpp:380)
-    imp = gbdt.feature_importance("split") if gbdt.train_set is not None else np.zeros(len(feature_names))
+    # feature importances (split counts) over exactly the dumped tree
+    # range, sorted desc (gbdt_model_text.cpp:380 FeatureImportance
+    # takes num_iteration). Summing over ALL models would let a sliced
+    # save (snapshot / training checkpoint) leak later trees into the
+    # footer — a checkpointed model must bit-match a run that stopped
+    # at that round (docs/RESILIENCE.md).
+    imp = np.zeros(len(feature_names))
+    for t in gbdt.models[start_model:num_used]:
+        imp += t.feature_importance_split(len(feature_names))
     pairs = [(int(imp[i]), feature_names[i]) for i in range(len(feature_names)) if imp[i] > 0]
     pairs.sort(key=lambda p: -p[0])
     buf.write("\nfeature_importances:\n")

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import re
+import urllib.error
 import urllib.request
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
@@ -135,13 +136,31 @@ def parse_prometheus(text: str, process: int = 0) -> Dict[str, Any]:
 
 
 def pull_snapshot(url: str, timeout: float = 10.0,
-                  process: int = 0) -> Dict[str, Any]:
+                  process: int = 0, retries: int = 2) -> Dict[str, Any]:
     """HTTP-scrape one worker's ``/metrics`` endpoint (the serving
-    transport's route, server.py) into a snapshot."""
+    transport's route, server.py) into a snapshot.
+
+    Transient transport failures (connection refused mid-restart, a
+    scrape racing server startup) retry with backoff; an HTTP error
+    status is a real answer from a live server and fails immediately
+    (retrying a 404 would just repeat it)."""
+    from ..resilience.backoff import retry_call
+
     if not url.rstrip("/").endswith("/metrics"):
         url = url.rstrip("/") + "/metrics"
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return parse_prometheus(r.read().decode(), process=process)
+
+    def _pull() -> Dict[str, Any]:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return parse_prometheus(r.read().decode(), process=process)
+
+    return retry_call(
+        _pull,
+        retries=retries,
+        base_s=0.25,
+        retry_on=(urllib.error.URLError, OSError),
+        retriable=lambda e: not isinstance(e, urllib.error.HTTPError),
+        describe=f"scrape {url}",
+    )
 
 
 # --------------------------------------------------------------- merge

@@ -28,7 +28,13 @@ Policy (``anomaly_policy`` config/CLI param):
 - ``abort`` — same, then raises :class:`AnomalyAbort`. The engine
   flushes the flight recorder in its ``finally`` and lets the typed
   exception propagate, so the JSONL tail and the run manifest survive
-  the abort (regression-tested).
+  the abort (regression-tested);
+- ``rollback`` — raises like ``abort``, but engine.train catches it
+  and, when a ``snapshot_freq`` checkpoint exists, restores the last
+  good round and retrains (optionally with a shrunken learning_rate,
+  ``anomaly_rollback_lr_decay``) instead of discarding the run —
+  docs/RESILIENCE.md "Recovery policies". Without a checkpoint it
+  degrades to ``abort``.
 
 Host-side only; consumes plain dict records, never device values.
 """
@@ -41,7 +47,7 @@ from typing import Any, Deque, Dict, List, Optional
 
 from .. import log
 
-POLICIES = ("off", "warn", "abort")
+POLICIES = ("off", "warn", "abort", "rollback")
 
 
 class AnomalyAbort(RuntimeError):
@@ -123,7 +129,9 @@ class AnomalySentinel:
                 {"round": round_idx, "detail": detail},
             )
         log.warning(f"anomaly[{kind}] at round {round_idx}: {detail}")
-        if self.policy == "abort":
+        if self.policy in ("abort", "rollback"):
+            # rollback rides the same typed raise: engine.train owns the
+            # checkpoint-restore decision, not the sentinel
             raise AnomalyAbort(kind, round_idx, detail)
 
     # ------------------------------------------------------------ check

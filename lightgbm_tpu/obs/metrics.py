@@ -488,6 +488,30 @@ def record_coalesce(entry: str, n_requests: int, rows: int) -> None:
                 ).observe(rows, entry=entry)
 
 
+def record_host_fallback(entry: str) -> None:
+    """One serving chunk scored by the host tree-walker after a device
+    scoring fault (docs/RESILIENCE.md "Serving degradation")."""
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_serve_host_fallback_total",
+              "chunks degraded to the host tree-walker after a device "
+              "scoring fault",
+              labels=("entry",)).inc(1, entry=entry)
+
+
+def record_serve_rejection(entry: str, kind: str) -> None:
+    """A serving request rejected before scoring: queue overflow
+    (admission control) or deadline expiry."""
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_serve_rejected_total",
+              "requests rejected by admission control or deadline "
+              "expiry, by kind",
+              labels=("entry", "kind")).inc(1, entry=entry, kind=kind)
+
+
 def record_registry_event(event: str, model: str) -> None:
     """Model-registry lifecycle: load / swap / rollback / unload."""
     r = _default

@@ -99,9 +99,16 @@ class FlightRecorder:
     ``path=None`` runs the recorder in memory only (the anomaly
     sentinels still consume records; nothing is written) — that is the
     ``anomaly_policy != off`` without ``record_file`` configuration.
+
+    ``resume_bytes`` (checkpoint/resume, docs/RESILIENCE.md) truncates
+    an existing stream back to that byte offset — the size the training
+    checkpoint captured after its round's record was flushed — and
+    appends, so a resumed run's record file carries each round exactly
+    once with no torn tail and no duplicated header.
     """
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 resume_bytes: Optional[int] = None):
         self.path = path
         self._lock = threading.Lock()
         self._fh = None
@@ -113,10 +120,18 @@ class FlightRecorder:
         self._t0 = time.time()
         self._anomalies: Dict[str, int] = {}
         if path:
-            self._fh = open(path, "w")
-            header = {"schema": SCHEMA, "created_unix": self._t0}
-            self._fh.write(json.dumps(header) + "\n")
-            self._fh.flush()
+            import os
+
+            if resume_bytes is not None and os.path.exists(path):
+                self._fh = open(path, "r+")
+                self._fh.truncate(int(resume_bytes))
+                self._fh.seek(0, 2)  # append after the surviving records
+                self._fh.flush()
+            else:
+                self._fh = open(path, "w")
+                header = {"schema": SCHEMA, "created_unix": self._t0}
+                self._fh.write(json.dumps(header) + "\n")
+                self._fh.flush()
 
     # ------------------------------------------------------- phase sink
     def attach(self) -> "FlightRecorder":

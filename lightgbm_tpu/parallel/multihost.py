@@ -94,8 +94,20 @@ def init_distributed(
     coord = mlist[0]
     if ":" not in coord:
         coord = f"{coord}:{local_listen_port}"
-    jax.distributed.initialize(
-        coordinator_address=coord, num_processes=n, process_id=rank
+    from ..resilience.backoff import retry_call
+
+    # cluster join races the coordinator's startup: workers that boot
+    # first see connection errors. Bounded retry-with-backoff instead
+    # of failing the whole fleet on a few seconds' skew
+    # (docs/RESILIENCE.md "Distributed recovery").
+    retry_call(
+        lambda: jax.distributed.initialize(
+            coordinator_address=coord, num_processes=n, process_id=rank
+        ),
+        retries=3,
+        base_s=1.0,
+        retry_on=(OSError, RuntimeError),
+        describe=f"jax.distributed.initialize({coord}, rank {rank})",
     )
     return rank
 
@@ -289,11 +301,28 @@ def run_distributed(
         valid_sets = [vs]
         valid_names = ["valid"]
 
-    bst = engine.train(
-        params, ds, num_boost_round=num_boost_round,
-        valid_sets=valid_sets, valid_names=valid_names,
-        callbacks=callbacks,
-    )
+    heartbeat = None
+    if obs_snapshot_dir:
+        # per-worker liveness files next to the metrics snapshots: a
+        # rank that dies mid-train stops beating, and rank 0's health
+        # report (below) names it — without any collective, so death
+        # detection works precisely when the training fabric is what
+        # broke (docs/RESILIENCE.md "Distributed recovery")
+        from ..resilience.heartbeat import HeartbeatWriter
+
+        heartbeat = HeartbeatWriter(obs_snapshot_dir, rank)
+        heartbeat.start()
+    try:
+        bst = engine.train(
+            params, ds, num_boost_round=num_boost_round,
+            valid_sets=valid_sets, valid_names=valid_names,
+            callbacks=callbacks,
+        )
+    finally:
+        if heartbeat is not None:
+            # clean exits write a final beat; a crash here leaves the
+            # file stale, which is exactly what flags the death
+            heartbeat.stop()
     bst._distributed_rank = rank
     if obs_snapshot_dir:
         # fleet observability: every rank dumps its registry; rank 0
@@ -308,6 +337,19 @@ def run_distributed(
             merged = merged_fleet_snapshot(obs_snapshot_dir)
             bst._fleet_metrics = merged
             from .. import log
+            from ..resilience.heartbeat import health_report
+
+            health = health_report(
+                obs_snapshot_dir, expected=jax.process_count()
+            )
+            bst._fleet_health = health
+            if not health["healthy"]:
+                log.warning(
+                    f"fleet health: stale rank(s) {health['stale']}, "
+                    f"missing rank(s) {health['missing']} — a worker "
+                    "likely died mid-train; restart the fleet with "
+                    "resume=auto to continue from the last checkpoint"
+                )
 
             n = merged.get("processes", 0)
             total = jax.process_count()

@@ -26,7 +26,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -35,8 +35,16 @@ from ..config import DEFAULT_SERVE_BUCKETS as DEFAULT_BUCKETS
 from ..obs.metrics import (
     record_bucket_dispatch,
     record_coalesce,
+    record_host_fallback,
     record_queue_depth,
+    record_serve_rejection,
 )
+from ..resilience.errors import (
+    DeadlineExceeded,
+    QueueOverflow,
+    ShutdownError,
+)
+from ..resilience.faultinject import fault_point
 from ..timer import latency_stats
 
 
@@ -62,6 +70,17 @@ class BucketDispatcher:
         self.forest = forest
         self.name = name
         self._stats = latency_stats(name)
+        # degradation path (docs/RESILIENCE.md): when a device scoring
+        # call faults, a chunk can be rescored by the host tree-walker
+        # instead of failing the request. The registry installs this as
+        # a closure over the source Booster: (chunk (n,F) f32, start,
+        # end) -> (summed raw margins (n,K), leaf indices (n,T) with
+        # the used range at columns [start*K, end*K)). None = fail fast.
+        self.host_fallback: Optional[
+            Callable[[np.ndarray, int, int],
+                     Tuple[np.ndarray, np.ndarray]]
+        ] = None
+        self._fallback_warned = False
 
     # ------------------------------------------------------------------
     def bucket_for(self, n: int) -> int:
@@ -90,11 +109,18 @@ class BucketDispatcher:
             score.block_until_ready()
 
     # ------------------------------------------------------------------
-    def _bucketed_chunks(self, X: np.ndarray, tw: np.ndarray):
+    def _bucketed_chunks(self, X: np.ndarray, tw: np.ndarray,
+                         start: int = 0, end: int = 0):
         """Yield (score (n,K), leaf (n,T)) per max-bucket chunk, each
         scored at its padded ladder shape — EVERY device call in the
         dispatcher goes through here, so no request shape escapes the
-        ladder (the bounded-compiles contract covers pred_leaf too)."""
+        ladder (the bounded-compiles contract covers pred_leaf too).
+
+        A device fault mid-chunk (the ``device_put`` fault-injection
+        site models one) degrades THAT chunk to the host tree-walker
+        when ``host_fallback`` is installed: slower, metric-counted,
+        warned once — but the request still answers (parity is
+        regression-tested in tests/test_resilience.py)."""
         import jax.numpy as jnp
 
         N = X.shape[0]
@@ -109,8 +135,28 @@ class BucketDispatcher:
                 chunk = np.concatenate(
                     [chunk, np.zeros((b - rows, X.shape[1]), np.float32)]
                 )
-            score, leaf = self.forest.apply(jnp.asarray(chunk), tw)
-            yield np.asarray(score)[:rows], np.asarray(leaf)[:rows]
+            try:
+                fault_point("device_put")
+                score, leaf = self.forest.apply(jnp.asarray(chunk), tw)
+                out = np.asarray(score)[:rows], np.asarray(leaf)[:rows]
+            except Exception:  # noqa: BLE001 — any device-path fault
+                if self.host_fallback is None:
+                    raise
+                if not self._fallback_warned:
+                    self._fallback_warned = True
+                    log.warning(
+                        f"device scoring fault on entry "
+                        f"{self.name!r}; degrading faulted chunks to "
+                        "the host tree-walker (slower; counted in "
+                        "lgbmtpu_serve_host_fallback_total)"
+                    )
+                record_host_fallback(self.name)
+                s, lf = self.host_fallback(chunk[:rows], start, end)
+                out = (
+                    np.asarray(s, np.float32),
+                    np.asarray(lf)[:rows],
+                )
+            yield out
             pos += top
 
     def _prep(self, X, start_iteration: int, num_iteration: int):
@@ -130,7 +176,7 @@ class BucketDispatcher:
         if X.shape[0] == 0:  # filtered-empty request, not an error
             return np.zeros((self.forest.num_class, 0), np.float64)
         t0 = time.perf_counter()
-        outs = [s for s, _ in self._bucketed_chunks(X, tw)]
+        outs = [s for s, _ in self._bucketed_chunks(X, tw, start, end)]
         out = np.concatenate(outs).T.astype(np.float64)  # (K, N)
         if self.forest.average_output and end > start:
             out /= end - start
@@ -147,7 +193,7 @@ class BucketDispatcher:
         if X.shape[0] == 0:
             return np.zeros((0, (end - start) * K), np.int64)
         t0 = time.perf_counter()
-        leaves = [lf for _, lf in self._bucketed_chunks(X, tw)]
+        leaves = [lf for _, lf in self._bucketed_chunks(X, tw, start, end)]
         out = np.concatenate(leaves)[:, start * K: end * K]
         self._stats.observe(time.perf_counter() - t0, X.shape[0])
         return out.astype(np.int64)
@@ -162,13 +208,36 @@ class MicroBatcher:
     submit(rows) -> Future resolving to that request's (n, K) scores.
     One worker thread drains the queue: everything pending (up to the
     largest bucket) coalesces into a single padded device call.
+
+    Overload handling (docs/RESILIENCE.md "Serving degradation"):
+
+    - ``queue_cap`` bounds the ROWS admitted to the queue; a submit
+      past the cap fast-fails with :class:`QueueOverflow` in the
+      caller's thread (the HTTP transport maps it to 503 +
+      Retry-After) instead of growing an unbounded backlog whose tail
+      latency is already hopeless.
+    - ``deadline_s`` (per-instance default, overridable per submit)
+      bounds time-in-queue: the worker sweeps expired requests on
+      every drain and fails them with :class:`DeadlineExceeded` (HTTP
+      504) without spending a device call on them. A request already
+      coalesced into a device call is never cancelled.
+    - ``close()`` fails everything still queued with
+      :class:`ShutdownError` — a shutdown must never leave a caller
+      blocked forever on ``Future.result()``.
     """
 
     def __init__(self, dispatcher: BucketDispatcher,
-                 max_delay_s: float = 0.002):
+                 max_delay_s: float = 0.002,
+                 deadline_s: float = 0.0,
+                 queue_cap: int = 0):
         self.dispatcher = dispatcher
         self.max_delay_s = float(max_delay_s)
-        self._pending: List[Tuple[np.ndarray, Future]] = []
+        self.deadline_s = float(deadline_s)  # 0 = no default deadline
+        self.queue_cap = int(queue_cap)      # rows; 0 = unbounded
+        # entries are (X, future, expiry | None) in monotonic time
+        self._pending: List[Tuple[np.ndarray, Future,
+                                  Optional[float]]] = []
+        self._pending_rows = 0
         self._cond = threading.Condition()
         self._closed = False
         self._worker = threading.Thread(
@@ -176,12 +245,14 @@ class MicroBatcher:
         )
         self._worker.start()
 
-    def submit(self, X: np.ndarray) -> Future:
+    def submit(self, X: np.ndarray,
+               deadline_s: Optional[float] = None) -> Future:
         """Queue rows for coalesced default-parameter scoring; resolves
         to that request's (n, K) RAW margins. Non-default scoring
         options (truncation, pred_leaf) go through the dispatcher
         directly — requests in one coalesced batch must share one
-        parameter set."""
+        parameter set. ``deadline_s`` overrides the instance default
+        (<= 0 disables the deadline for this request)."""
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -189,51 +260,115 @@ class MicroBatcher:
         # fail ITS caller, never the innocent requests it would have
         # been coalesced with
         self.dispatcher.forest._check_width(X)
+        dl = self.deadline_s if deadline_s is None else float(deadline_s)
+        expiry = time.monotonic() + dl if dl > 0 else None
         fut: Future = Future()
-        with self._cond:
-            if self._closed:
-                raise RuntimeError("MicroBatcher is closed")
-            self._pending.append((X, fut))
-            depth = len(self._pending)
-            self._cond.notify()
-        # gauge update outside the condition: the metrics registry has
-        # its own lock and must not nest under the queue's
+        try:
+            with self._cond:
+                if self._closed:
+                    raise ShutdownError("MicroBatcher is closed")
+                # admission control: reject while a backlog exists (a
+                # single request larger than the cap is still admitted
+                # into an EMPTY queue — it chunks through the ladder)
+                if (self.queue_cap > 0 and self._pending
+                        and self._pending_rows + X.shape[0]
+                        > self.queue_cap):
+                    raise QueueOverflow(
+                        f"microbatch queue full "
+                        f"({self._pending_rows} rows queued, "
+                        f"cap {self.queue_cap})"
+                    )
+                self._pending.append((X, fut, expiry))
+                self._pending_rows += X.shape[0]
+                depth = len(self._pending)
+                self._cond.notify()
+        except QueueOverflow:
+            # counter outside the condition: the metrics registry has
+            # its own lock and must not nest under the queue's
+            record_serve_rejection(self.dispatcher.name, "overloaded")
+            raise
         record_queue_depth(self.dispatcher.name, depth)
         return fut
 
     def close(self) -> None:
+        """Stop the worker and fail anything still pending with
+        ShutdownError. The worker drains the queue on the way out; the
+        explicit sweep below only matters when it cannot finish within
+        the join timeout (e.g. wedged mid-device-call) — futures must
+        fail, not hang their callers forever."""
         with self._cond:
             self._closed = True
             self._cond.notify()
         self._worker.join(timeout=5)
+        with self._cond:
+            leftovers = self._pending
+            self._pending = []
+            self._pending_rows = 0
+        for _, fut, _ in leftovers:  # outside the lock: may run callbacks
+            if not fut.done():
+                fut.set_exception(
+                    ShutdownError("MicroBatcher closed before scoring")
+                )
 
     # ------------------------------------------------------------------
+    def _sweep_expired_locked(
+        self, now: float
+    ) -> List[Tuple[np.ndarray, Future, Optional[float]]]:
+        """Pop expired entries (caller holds the condition; the popped
+        futures are failed OUTSIDE the lock — done-callbacks may run)."""
+        expired = [e for e in self._pending
+                   if e[2] is not None and now >= e[2]]
+        if expired:
+            # both callers hold self._cond (the _locked suffix is the
+            # contract; the per-function lint cannot see the call sites)
+            self._pending = [e for e in self._pending  # lint: allow[unlocked-write]
+                             if e[2] is None or now < e[2]]
+            self._pending_rows = sum(  # lint: allow[unlocked-write]
+                e[0].shape[0] for e in self._pending
+            )
+        return expired
+
     def _run(self) -> None:
         top = self.dispatcher.buckets[-1]
         while True:
+            expired: List[Tuple[np.ndarray, Future, Optional[float]]] = []
+            batch: List[Tuple[np.ndarray, Future]] = []
+            rows = 0
             with self._cond:
                 while not self._pending and not self._closed:
                     self._cond.wait()
                 if self._closed and not self._pending:
                     return
+                expired = self._sweep_expired_locked(time.monotonic())
                 # brief linger so near-simultaneous submitters coalesce
                 if (len(self._pending) == 1
                         and self._pending[0][0].shape[0] < top
                         and not self._closed):
                     self._cond.wait(self.max_delay_s)
-                batch: List[Tuple[np.ndarray, Future]] = []
-                rows = 0
-                # coalesce only same-width requests (widths >= the
-                # model's widest feature are all valid, so a mixed
-                # queue would break np.concatenate); stragglers stay
-                # pending for the next drain
-                width = self._pending[0][0].shape[1]
-                while (self._pending and rows < top
-                       and self._pending[0][0].shape[1] == width):
-                    X, fut = self._pending.pop(0)
-                    batch.append((X, fut))
-                    rows += X.shape[0]
+                    expired += self._sweep_expired_locked(
+                        time.monotonic()
+                    )
+                if self._pending:
+                    # coalesce only same-width requests (widths >= the
+                    # model's widest feature are all valid, so a mixed
+                    # queue would break np.concatenate); stragglers
+                    # stay pending for the next drain
+                    width = self._pending[0][0].shape[1]
+                    while (self._pending and rows < top
+                           and self._pending[0][0].shape[1] == width):
+                        X, fut, _ = self._pending.pop(0)
+                        self._pending_rows -= X.shape[0]
+                        batch.append((X, fut))
+                        rows += X.shape[0]
                 depth = len(self._pending)
+            for _, fut, _ in expired:
+                record_serve_rejection(self.dispatcher.name, "deadline")
+                if not fut.done():
+                    fut.set_exception(DeadlineExceeded(
+                        "request expired in the microbatch queue"
+                    ))
+            if not batch:
+                continue
             record_queue_depth(self.dispatcher.name, depth)
             record_coalesce(self.dispatcher.name, len(batch), rows)
             try:

@@ -77,14 +77,58 @@ def _booster_from(source: Any):
     return Booster(model_file=s), s
 
 
+def _make_host_fallback(booster, forest):
+    """Degradation closure for BucketDispatcher.host_fallback: rescore
+    a faulted chunk with the HOST tree-walker (Booster.predict's
+    default device=None path — no jax in the loop), returning the
+    dispatcher's internal layout: summed raw margins (the dispatcher
+    divides average_output models itself) and a full-width leaf matrix
+    with the used tree range in place (docs/RESILIENCE.md)."""
+    K = forest.num_class
+    T = forest.num_trees
+
+    def fallback(chunk, start, end):
+        n = chunk.shape[0]
+        ni = end - start if end > start else -1
+        raw = booster.predict(
+            chunk, start_iteration=start, num_iteration=ni,
+            raw_score=True,
+        )
+        raw = np.asarray(raw, np.float64).reshape(n, K)
+        if forest.average_output and end > start:
+            # host predict averages; the dispatcher re-divides summed
+            # chunk margins by (end - start) after concatenation
+            raw = raw * (end - start)
+        leaf = booster.predict(
+            chunk, start_iteration=start, num_iteration=ni,
+            pred_leaf=True,
+        )
+        leaf_full = np.zeros((n, T), np.int64)
+        leaf_full[:, start * K: end * K] = (
+            np.asarray(leaf, np.int64).reshape(n, -1)
+        )
+        return raw, leaf_full
+
+    return fallback
+
+
 class ModelRegistry:
     """Thread-safe named + versioned model store (docs/SERVING.md)."""
 
     def __init__(self, mesh=None, buckets=DEFAULT_BUCKETS,
-                 warmup: bool = False):
+                 warmup: bool = False, deadline_s: float = 0.0,
+                 queue_cap: int = 0, host_fallback: bool = True):
         self.mesh = mesh
         self.buckets = tuple(int(b) for b in buckets)
         self.default_warmup = bool(warmup)
+        # resilience knobs (docs/RESILIENCE.md "Serving degradation"):
+        # default queue deadline + admission cap for every lazily-built
+        # MicroBatcher (serve_deadline_ms / serve_queue_cap params),
+        # and whether device scoring faults degrade to the host
+        # tree-walker instead of failing the request
+        self.deadline_s = float(deadline_s)
+        self.queue_cap = int(queue_cap)
+        self.host_fallback = bool(host_fallback)
         self._lock = threading.RLock()
         self._models: Dict[str, List[ModelVersion]] = {}
         self._active: Dict[str, int] = {}
@@ -102,6 +146,8 @@ class ModelRegistry:
         dispatcher = BucketDispatcher(
             forest, self.buckets, name=f"serve:{name}"
         )
+        if self.host_fallback:
+            dispatcher.host_fallback = _make_host_fallback(booster, forest)
         do_warm = self.default_warmup if warmup is None else warmup
         if do_warm:
             if num_features is None:
@@ -211,7 +257,8 @@ class ModelRegistry:
     def predict(self, name: str, X, *, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
                 pred_leaf: bool = False, via_queue: bool = False,
-                version: Optional[int] = None) -> np.ndarray:
+                version: Optional[int] = None,
+                deadline_s: Optional[float] = None) -> np.ndarray:
         """One scoring entry point for every registered model; output
         layout matches Booster.predict ((N,) single-class, (N, K)
         multiclass, (N, T) for pred_leaf).
@@ -240,10 +287,16 @@ class ModelRegistry:
                     if mv.batcher is None:
                         from .dispatch import MicroBatcher
 
-                        mv.batcher = MicroBatcher(mv.dispatcher)
+                        mv.batcher = MicroBatcher(
+                            mv.dispatcher,
+                            deadline_s=self.deadline_s,
+                            queue_cap=self.queue_cap,
+                        )
                     batcher = mv.batcher
         if batcher is not None:
-            raw = batcher.submit(X).result().T  # (K, n)
+            # per-request deadline overrides the registry default;
+            # QueueOverflow / DeadlineExceeded propagate to the caller
+            raw = batcher.submit(X, deadline_s=deadline_s).result().T
         else:
             raw = mv.dispatcher.score_raw(X, start_iteration, num_iteration)
         g = mv.booster._gbdt

@@ -36,7 +36,35 @@ import numpy as np
 
 from .. import log
 from ..obs.metrics import default_registry, record_request_op
+from ..resilience.errors import (
+    DeadlineExceeded,
+    InjectedFault,
+    QueueOverflow,
+    ShutdownError,
+)
+from ..resilience.faultinject import fault_point
 from .registry import ModelRegistry
+
+# typed failure -> HTTP status (the JSONL transport carries the same
+# "error_kind" field; docs/RESILIENCE.md "Serving degradation")
+ERROR_STATUS = {
+    "overloaded": 503,  # queue admission rejected: retry later
+    "deadline": 504,    # expired waiting in the microbatch queue
+    "shutdown": 503,    # server draining: retry against a peer
+    "fault": 500,       # injected / unexpected scoring fault
+}
+
+
+def _error_kind(e: Exception) -> Optional[str]:
+    if isinstance(e, QueueOverflow):
+        return "overloaded"
+    if isinstance(e, DeadlineExceeded):
+        return "deadline"
+    if isinstance(e, ShutdownError):
+        return "shutdown"
+    if isinstance(e, InjectedFault):
+        return "fault"
+    return None
 
 
 def handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, Any]:
@@ -51,6 +79,10 @@ def handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, An
 def _handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, Any]:
     op = req.get("op", "score")
     try:
+        # chaos-test hook: a planned fault can delay or fail the Nth
+        # request here (fault_plan "serve_request:N:..."), exercising
+        # the exact degradation paths production failures would take
+        fault_point("serve_request")
         if op == "ping":
             return {"ok": True, "pong": True}
         if op == "models":
@@ -75,6 +107,7 @@ def _handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, A
             return {"ok": True, "active": v}
         if op == "score":
             rows = np.asarray(req["rows"], np.float32)
+            dl_ms = req.get("deadline_ms")
             pred = registry.predict(
                 req.get("model", "default"), rows,
                 raw_score=bool(req.get("raw_score", False)),
@@ -83,13 +116,21 @@ def _handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, A
                 pred_leaf=bool(req.get("pred_leaf", False)),
                 via_queue=bool(req.get("queue", False)),
                 version=req.get("version"),
+                deadline_s=(float(dl_ms) / 1000.0
+                            if dl_ms is not None else None),
             )
             return {"ok": True, "pred": np.asarray(pred).tolist()}
         if op == "quit":
             return {"ok": True, "quit": True}
         raise ValueError(f"unknown op {op!r}")
     except Exception as e:  # noqa: BLE001 — a bad request must not kill serving
-        return {"ok": False, "op": op, "error": f"{type(e).__name__}: {e}"}
+        resp = {"ok": False, "op": op, "error": f"{type(e).__name__}: {e}"}
+        kind = _error_kind(e)
+        if kind is not None:
+            resp["error_kind"] = kind
+        if isinstance(e, QueueOverflow):
+            resp["retry_after_s"] = e.retry_after_s
+        return resp
 
 
 class ScoringServer:
@@ -137,10 +178,18 @@ def serve_http(registry: ModelRegistry, port: int,
         def _reply(self, resp: Dict[str, Any], code: int = 200) -> None:
             body = json.dumps(resp).encode()
             if code == 200 and not resp.get("ok", True):
-                code = 400  # handler errors; explicit codes (404) win
+                # typed resilience failures map to their own statuses
+                # (503 overloaded / 504 deadline); anything else is a
+                # handler error; explicit codes (404) win
+                code = ERROR_STATUS.get(resp.get("error_kind"), 400)
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if resp.get("error_kind") == "overloaded":
+                self.send_header(
+                    "Retry-After",
+                    str(max(int(resp.get("retry_after_s", 1)), 1)),
+                )
             self.end_headers()
             self.wfile.write(body)
 

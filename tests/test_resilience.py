@@ -1,0 +1,726 @@
+"""Fault-tolerant training & serving (lightgbm_tpu/resilience,
+docs/RESILIENCE.md).
+
+The contract under test, end to end: a training run crashed at an
+arbitrary round resumes via ``resume=auto`` and produces a model
+string BIT-IDENTICAL to the uninterrupted run (stateless fold_in RNG +
+crash-consistent checkpoints); serving degrades instead of dying —
+deadline'd requests raise typed :class:`DeadlineExceeded`, an over-cap
+burst fast-fails with :class:`QueueOverflow` (HTTP 503 + Retry-After)
+without poisoning in-flight futures, and an injected device fault
+falls back to host scoring with unchanged predictions. Faults are
+planted deterministically by resilience/faultinject.py — the ``chaos``
+marker ties these to tools/chaos.sh."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.metrics import default_registry
+from lightgbm_tpu.resilience import checkpoint as ckpt
+from lightgbm_tpu.resilience import faultinject
+from lightgbm_tpu.resilience.backoff import backoff_delay, delays, retry_call
+from lightgbm_tpu.resilience.errors import (
+    CheckpointError,
+    DeadlineExceeded,
+    InjectedFault,
+    QueueOverflow,
+    ShutdownError,
+)
+from lightgbm_tpu.resilience.heartbeat import (
+    HeartbeatWriter,
+    health_report,
+    heartbeat_path,
+    read_heartbeats,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _disarm_fault_plan():
+    """Chaos tests arm process-global fault plans; none may leak."""
+    yield
+    faultinject.disarm()
+
+
+# ===================================================== checkpoint file
+def test_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "run.ckpt")
+    hist = [[("v", "l2", 0.5, False)], [("v", "l2", 0.4, False)]]
+    ckpt.save_checkpoint(
+        path, "tree\nv=4\n", engine_round=2, total_iters=7,
+        eval_history=hist, record_offset=123, fingerprint="abcd",
+    )
+    state = ckpt.load_checkpoint(path)
+    assert state["engine_round"] == 2
+    assert state["total_iters"] == 7
+    assert state["model"] == "tree\nv=4\n"
+    assert state["record_offset"] == 123
+    assert state["fingerprint"] == "abcd"
+    # eval rows come back as tuples, positionally identical
+    assert state["eval_history"] == [[("v", "l2", 0.5, False)],
+                                     [("v", "l2", 0.4, False)]]
+    # rolling: a later save atomically replaces, no tmp file left
+    ckpt.save_checkpoint(path, "m2", engine_round=4, total_iters=9)
+    assert ckpt.load_checkpoint(path)["engine_round"] == 4
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_checkpoint_corrupt_and_missing(tmp_path):
+    torn = tmp_path / "torn.ckpt"
+    torn.write_text('{"schema": "lightgbm-tpu/checkpoint/v1", "eng')
+    with pytest.raises(CheckpointError, match="corrupt"):
+        ckpt.load_checkpoint(str(torn))
+    alien = tmp_path / "alien.ckpt"
+    alien.write_text(json.dumps({"schema": "something/else"}))
+    with pytest.raises(CheckpointError, match="schema"):
+        ckpt.load_checkpoint(str(alien))
+    incomplete = tmp_path / "inc.ckpt"
+    incomplete.write_text(json.dumps(
+        {"schema": ckpt.SCHEMA, "engine_round": 1}
+    ))
+    with pytest.raises(CheckpointError, match="missing"):
+        ckpt.load_checkpoint(str(incomplete))
+    with pytest.raises(CheckpointError, match="cannot read"):
+        ckpt.load_checkpoint(str(tmp_path / "absent.ckpt"))
+    # resume=auto treats an ABSENT rolling checkpoint as a fresh start…
+    assert ckpt.find_resume_checkpoint(
+        "auto", "", str(tmp_path / "absent.ckpt")
+    ) == (None, None)
+    # …but a corrupt one is surfaced, never silently retrained over
+    with pytest.raises(CheckpointError):
+        ckpt.find_resume_checkpoint("auto", "", str(torn))
+    # resume_from= names an explicit file: absent is an error
+    with pytest.raises(CheckpointError):
+        ckpt.find_resume_checkpoint("off", str(tmp_path / "no.ckpt"),
+                                    str(torn))
+
+
+def test_config_fingerprint_ignores_recovery_knobs():
+    base = {"objective": "binary", "num_leaves": 31, "seed": 7}
+    fp = ckpt.config_fingerprint(base)
+    # rollback legitimately shrinks learning_rate and sets resume keys
+    assert ckpt.config_fingerprint(
+        dict(base, learning_rate=0.05, resume="auto",
+             resume_from="x.ckpt", fault_plan="round:3:raise")
+    ) == fp
+    assert ckpt.config_fingerprint(dict(base, num_leaves=15)) != fp
+
+
+def test_truncate_eval_history():
+    hist = [[("v", "l2", float(i), False)] for i in range(5)]
+    assert ckpt.truncate_eval_history(hist, 3) == hist[:3]
+    assert ckpt.truncate_eval_history(hist, 0) == []
+    assert ckpt.truncate_eval_history(hist, -2) == []
+    assert ckpt.truncate_eval_history(hist, 99) == hist
+
+
+# ============================================================ backoff
+def test_backoff_schedule():
+    assert backoff_delay(1, base_s=10, cap_s=120) == 10
+    assert backoff_delay(2, base_s=10, cap_s=120) == 20
+    assert backoff_delay(5, base_s=10, cap_s=120) == 120  # capped
+    assert delays(3, base_s=10) == [10.0, 20.0, 40.0]
+    with pytest.raises(ValueError):
+        backoff_delay(0)
+
+
+def test_retry_call_retries_then_succeeds():
+    calls, slept, seen = [], [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_call(
+        flaky, retries=5, base_s=0.5, sleep=slept.append,
+        on_retry=lambda a, d, e: seen.append((a, d, type(e).__name__)),
+    )
+    assert out == "ok" and len(calls) == 3
+    assert slept == [0.5, 1.0]
+    assert seen == [(1, 0.5, "OSError"), (2, 1.0, "OSError")]
+
+
+def test_retry_call_gives_up_and_respects_predicate():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(always, retries=2, base_s=0.1, sleep=lambda s: None)
+    assert len(calls) == 3  # first attempt + 2 retries
+
+    calls.clear()
+    # the retriable predicate is how pull_snapshot refuses to retry an
+    # HTTP error status: fail-fast on the first attempt
+    with pytest.raises(OSError):
+        retry_call(always, retries=5, retriable=lambda e: False,
+                   sleep=lambda s: None)
+    assert len(calls) == 1
+
+    # a non-matching exception type propagates without any retry
+    def typed():
+        calls.append(1)
+        raise ValueError("not retriable")
+
+    calls.clear()
+    with pytest.raises(ValueError):
+        retry_call(typed, retries=5, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+# ======================================================== fault plans
+def test_fault_plan_parsing():
+    plan = faultinject.FaultPlan(
+        "round:7:kill; device_put:1:raise, serve_request:2:delay:0.25"
+    )
+    assert [repr(c) for c in plan.clauses] == [
+        "round:7:kill", "device_put:1:raise",
+        "serve_request:2:delay:0.25",
+    ]
+    for bad in ("round:7", "nowhere:1:raise", "round:1:explode",
+                "serve_request:1:delay"):
+        with pytest.raises(ValueError):
+            faultinject.FaultPlan(bad)
+
+
+def test_fault_plan_one_shot_and_triggers():
+    plan = faultinject.arm("round:5:raise")
+    # index-triggered: only the exact round fires, and only once
+    plan.visit("round", index=4)
+    with pytest.raises(InjectedFault):
+        plan.visit("round", index=5)
+    plan.visit("round", index=5)  # clause already consumed
+
+    plan = faultinject.arm("serve_request:2:raise")
+    plan.visit("serve_request")  # 1st hit
+    with pytest.raises(InjectedFault):
+        plan.visit("serve_request")  # 2nd hit
+    plan.visit("serve_request")
+
+    t0 = time.monotonic()
+    faultinject.arm("device_put:1:delay:0.05").visit("device_put")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_fault_point_disarmed_and_env_configure(monkeypatch):
+    faultinject.disarm()
+    faultinject.fault_point("round", 3)  # no plan: pure no-op
+    monkeypatch.setenv(faultinject.ENV_VAR, "round:1:raise")
+    assert faultinject.configure("").spec == "round:1:raise"
+    # explicit config param wins over the env var
+    assert faultinject.configure("round:9:raise").spec == "round:9:raise"
+    monkeypatch.delenv(faultinject.ENV_VAR)
+    assert faultinject.configure("") is None
+    assert faultinject.active() is None
+
+
+# ========================================== crash/resume — bit match
+_RESUME_PARAMS = {
+    "objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+    "min_data_in_leaf": 5, "verbosity": -1, "seed": 7,
+    "bagging_fraction": 0.7, "bagging_freq": 1, "feature_fraction": 0.8,
+    "snapshot_freq": 5, "resume": "auto", "output_model": "model.txt",
+}
+
+
+def _resume_data():
+    rs = np.random.RandomState(11)
+    X = rs.randn(800, 6)
+    y = ((X @ rs.randn(6) + 0.3 * rs.randn(800)) > 0).astype(float)
+    return X, y
+
+
+def _train_in(dirpath, monkeypatch, plan=None):
+    """One train() run with per-run cwd: the model text embeds the
+    EXPLICIT params verbatim, so crash and clean runs must share an
+    identical params dict — relative output_model, fault plan via the
+    env var (never a param)."""
+    monkeypatch.chdir(dirpath)
+    if plan:
+        monkeypatch.setenv(faultinject.ENV_VAR, plan)
+    else:
+        monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+    X, y = _resume_data()
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    return lgb.train(dict(_RESUME_PARAMS), ds, num_boost_round=10)
+
+
+@pytest.mark.chaos
+def test_crash_resume_bit_identical(monkeypatch, tmp_path):
+    """Crash at round 7 (checkpoint at 5), resume=auto: the final
+    model string is bit-identical to the uninterrupted run — the
+    stateless fold_in sampling RNG plus the checkpoint's round index
+    ARE the whole training state. Re-resuming a finished run is
+    idempotent (0 remaining rounds, same bits)."""
+    crashed = tmp_path / "crashed"
+    clean = tmp_path / "clean"
+    crashed.mkdir()
+    clean.mkdir()
+
+    with pytest.raises(InjectedFault):
+        _train_in(crashed, monkeypatch, plan="round:7:raise")
+    state = ckpt.load_checkpoint(str(crashed / "model.txt.ckpt"))
+    assert state["engine_round"] == 5  # last snapshot_freq boundary
+
+    resumed = _train_in(crashed, monkeypatch)
+    uninterrupted = _train_in(clean, monkeypatch)
+    assert resumed.num_trees() == uninterrupted.num_trees() == 10
+    assert resumed.model_to_string() == uninterrupted.model_to_string()
+
+    # idempotent: resuming a COMPLETE checkpoint trains 0 rounds
+    again = _train_in(crashed, monkeypatch)
+    assert again.model_to_string() == uninterrupted.model_to_string()
+
+
+@pytest.mark.chaos
+def test_resume_replays_eval_history(monkeypatch, tmp_path):
+    """record_evaluation across a crash/resume sees the identical
+    metric sequence the uninterrupted run saw (the checkpoint carries
+    the eval history; resume replays it into stateful callbacks)."""
+    def run(dirpath, plan=None):
+        monkeypatch.chdir(dirpath)
+        if plan:
+            monkeypatch.setenv(faultinject.ENV_VAR, plan)
+        else:
+            monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+        X, y = _resume_data()
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        rs = np.random.RandomState(12)
+        Xv = rs.randn(200, 6)
+        vs = lgb.Dataset(Xv, label=(Xv[:, 0] > 0).astype(float),
+                         reference=ds, free_raw_data=False)
+        hist = {}
+        bst = lgb.train(
+            dict(_RESUME_PARAMS, metric="binary_logloss"), ds,
+            num_boost_round=10, valid_sets=[vs], valid_names=["v"],
+            callbacks=[lgb.record_evaluation(hist)],
+        )
+        return bst, hist
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir()
+    b.mkdir()
+    with pytest.raises(InjectedFault):
+        run(a, plan="round:7:raise")
+    bst_r, hist_r = run(a)
+    bst_c, hist_c = run(b)
+    assert bst_r.model_to_string() == bst_c.model_to_string()
+    assert len(hist_r["v"]["binary_logloss"]) == 10
+    assert hist_r == hist_c
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_sigkill_cli_resume_bit_identical(tmp_path):
+    """The real thing: a CLI training process SIGKILLed mid-boosting
+    (fault plan ``round:7:kill`` — no cleanup, no flush) resumes via
+    ``resume=auto`` and writes a model file byte-identical to an
+    uninterrupted run's."""
+    worker = str(REPO / "tests" / "_resilience_train_worker.py")
+    conf = (
+        "task = train\n"
+        "data = train.tsv\n"
+        "objective = binary\n"
+        "num_leaves = 15\n"
+        "num_trees = 8\n"
+        "learning_rate = 0.1\n"
+        "min_data_in_leaf = 5\n"
+        "seed = 7\n"
+        "bagging_fraction = 0.7\n"
+        "bagging_freq = 1\n"
+        "snapshot_freq = 3\n"
+        "resume = auto\n"
+        "output_model = model.txt\n"
+        "verbosity = -1\n"
+    )
+    rs = np.random.RandomState(3)
+    X = rs.randn(500, 5)
+    y = ((X @ rs.randn(5)) > 0).astype(float)
+
+    def setup(d):
+        d.mkdir()
+        np.savetxt(d / "train.tsv", np.column_stack([y, X]),
+                   delimiter="\t", fmt="%.8g")
+        (d / "train.conf").write_text(conf)
+
+    def run(d, plan=None, expect_kill=False):
+        env = dict(os.environ)
+        env.pop(faultinject.ENV_VAR, None)
+        if plan:
+            env[faultinject.ENV_VAR] = plan
+        p = subprocess.run(
+            [sys.executable, worker, "config=train.conf"],
+            cwd=str(d), env=env, timeout=600,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        if expect_kill:
+            assert p.returncode == -9, p.stdout.decode()
+        else:
+            assert p.returncode == 0, p.stdout.decode()
+
+    crashed, clean = tmp_path / "crashed", tmp_path / "clean"
+    setup(crashed)
+    setup(clean)
+    run(crashed, plan="round:7:kill", expect_kill=True)
+    assert not (crashed / "model.txt").exists()  # it really died
+    state = ckpt.load_checkpoint(str(crashed / "model.txt.ckpt"))
+    assert state["engine_round"] == 6  # last snapshot_freq=3 boundary
+    run(crashed)
+    run(clean)
+    assert (crashed / "model.txt").read_bytes() == \
+        (clean / "model.txt").read_bytes()
+
+
+# ========================================= anomaly rollback recovery
+def _diverging(rng, tmp_path, **over):
+    X = rng.randn(400, 4)
+    y = X[:, 0] + 0.1 * rng.randn(400)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    Xv = rng.randn(150, 4)
+    vs = lgb.Dataset(Xv, label=Xv[:, 0], reference=ds,
+                     free_raw_data=False)
+    params = {
+        "objective": "regression", "metric": "l2", "num_leaves": 7,
+        "learning_rate": 5.0, "verbosity": -1,
+        "anomaly_policy": "rollback", "snapshot_freq": 2,
+        "anomaly_rollback_lr_decay": 0.02, "anomaly_rollback_max": 2,
+        "record_file": str(tmp_path / "roll.jsonl"),
+        "output_model": str(tmp_path / "roll_model.txt"),
+    }
+    params.update(over)
+    return params, ds, vs
+
+
+@pytest.mark.chaos
+def test_anomaly_rollback_recovers(rng, tmp_path):
+    """learning_rate=5.0 trips the loss_spike sentinel; under
+    anomaly_policy=rollback the run restores the last checkpoint and
+    retrains with a shrunken learning_rate instead of aborting."""
+    from lightgbm_tpu.obs.anomaly import AnomalyAbort
+
+    params, ds, vs = _diverging(rng, tmp_path)
+    bst = lgb.train(params, ds, num_boost_round=14,
+                    valid_sets=[vs], valid_names=["v"])
+    assert bst.num_trees() == 14  # completed despite the divergence
+    # the rollback really went through a checkpoint restore
+    state = ckpt.load_checkpoint(str(tmp_path / "roll_model.txt.ckpt"))
+    assert state["engine_round"] == 14
+
+    # with the retry budget exhausted the policy degrades to abort
+    params2, ds2, vs2 = _diverging(rng, tmp_path,
+                                   anomaly_rollback_max=0)
+    with pytest.raises(AnomalyAbort):
+        lgb.train(params2, ds2, num_boost_round=14,
+                  valid_sets=[vs2], valid_names=["v"])
+
+
+# ======================================== serving: typed degradation
+def _serving_model(rng):
+    X = rng.randn(600, 5)
+    y = X @ rng.randn(5) + 0.1 * rng.randn(600)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    ds, num_boost_round=8)
+    return bst, X
+
+
+def _rejections(entry, kind):
+    return default_registry().counter(
+        "lgbmtpu_serve_rejected_total", labels=("entry", "kind")
+    ).value(entry=entry, kind=kind)
+
+
+@pytest.mark.chaos
+def test_deadline_exceeded_typed(rng):
+    """A request whose deadline passes in the queue fails with
+    DeadlineExceeded (a TimeoutError) before any device call is spent
+    on it, and counts into the rejection metric."""
+    from lightgbm_tpu.serving import MicroBatcher, ModelRegistry
+
+    bst, X = _serving_model(rng)
+    reg = ModelRegistry()
+    reg.load("m", bst.model_to_string())
+    mv = reg._entry("m")
+    before = _rejections("serve:m", "deadline")
+    mb = MicroBatcher(mv.dispatcher, max_delay_s=0.05)
+    try:
+        fut = mb.submit(X[:4], deadline_s=1e-7)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        assert isinstance(fut.exception(), TimeoutError)  # generic too
+        assert _rejections("serve:m", "deadline") == before + 1
+        # an undeadlined submit on the same batcher still scores fine
+        out = mb.submit(X[:4]).result(timeout=30)
+        np.testing.assert_allclose(
+            out.ravel(), bst.predict(X[:4], raw_score=True),
+            rtol=1e-5, atol=1e-6,
+        )
+    finally:
+        mb.close()
+
+
+@pytest.mark.chaos
+def test_queue_overflow_fast_fail_no_poisoning(rng):
+    """Admission control: while a backlog exists, a submit past the
+    row cap raises QueueOverflow in the CALLER's thread; the in-flight
+    and queued requests score normally (no poisoned futures)."""
+    from lightgbm_tpu.serving import MicroBatcher, ModelRegistry
+
+    bst, X = _serving_model(rng)
+    reg = ModelRegistry()
+    reg.load("m", bst.model_to_string())
+    mv = reg._entry("m")
+    before = _rejections("serve:m", "overloaded")
+    # hold the worker inside the first device call for 0.5 s so a
+    # backlog builds deterministically behind it
+    faultinject.arm("device_put:1:delay:0.5")
+    mb = MicroBatcher(mv.dispatcher, max_delay_s=0.001, queue_cap=8)
+    try:
+        fut_a = mb.submit(X[:4])
+        time.sleep(0.15)  # worker is now sleeping inside score_raw
+        fut_b = mb.submit(X[:6])  # empty queue: admitted
+        with pytest.raises(QueueOverflow) as ei:
+            mb.submit(X[:4])  # 6 + 4 > cap 8 while backlog exists
+        assert ei.value.retry_after_s >= 1
+        assert _rejections("serve:m", "overloaded") == before + 1
+        for fut, rows in ((fut_a, X[:4]), (fut_b, X[:6])):
+            np.testing.assert_allclose(
+                fut.result(timeout=30).ravel(),
+                bst.predict(rows, raw_score=True),
+                rtol=1e-5, atol=1e-6,
+            )
+    finally:
+        mb.close()
+
+
+@pytest.mark.chaos
+def test_device_fault_host_fallback_parity(rng):
+    """An injected device-put fault degrades that chunk to the host
+    tree-walker: predictions (and leaf indices) are unchanged, the
+    degradation is warn-once and metric-counted."""
+    from lightgbm_tpu.serving import ModelRegistry
+
+    bst, X = _serving_model(rng)
+    reg = ModelRegistry()
+    reg.load("m", bst.model_to_string())
+    c = default_registry().counter(
+        "lgbmtpu_serve_host_fallback_total", labels=("entry",)
+    )
+    before = c.value(entry="serve:m")
+
+    faultinject.arm("device_put:1:raise")
+    pred = reg.predict("m", X[:32])
+    np.testing.assert_allclose(pred, bst.predict(X[:32]),
+                               rtol=1e-5, atol=1e-6)
+    assert c.value(entry="serve:m") == before + 1
+    assert reg._entry("m").dispatcher._fallback_warned
+
+    faultinject.arm("device_put:1:raise")
+    leaf = reg.predict("m", X[:32], pred_leaf=True)
+    np.testing.assert_array_equal(leaf, bst.predict(X[:32],
+                                                    pred_leaf=True))
+    assert c.value(entry="serve:m") == before + 2
+
+    # without a fallback installed the fault propagates typed
+    reg2 = ModelRegistry(host_fallback=False)
+    reg2.load("m", bst.model_to_string())
+    faultinject.arm("device_put:1:raise")
+    with pytest.raises(InjectedFault):
+        reg2.predict("m", X[:32])
+
+
+@pytest.mark.chaos
+def test_serve_request_fault_site():
+    """The serve_request seam maps an injected fault to a typed 500
+    (error_kind=fault) and a delay clause stalls exactly one request."""
+    from lightgbm_tpu.serving import ModelRegistry
+    from lightgbm_tpu.serving.server import ERROR_STATUS, handle_request
+
+    reg = ModelRegistry()
+    faultinject.arm("serve_request:1:raise")
+    resp = handle_request(reg, {"op": "ping"})
+    assert not resp["ok"] and resp["error_kind"] == "fault"
+    assert ERROR_STATUS[resp["error_kind"]] == 500
+    assert handle_request(reg, {"op": "ping"})["ok"]  # one-shot
+
+    faultinject.arm("serve_request:1:delay:0.05")
+    t0 = time.monotonic()
+    assert handle_request(reg, {"op": "ping"})["ok"]
+    assert time.monotonic() - t0 >= 0.05
+
+
+@pytest.mark.chaos
+def test_http_degradation_statuses(rng):
+    """Over HTTP: a deadline'd request answers 504, an over-cap burst
+    answers 503 with a Retry-After header, in-flight requests still
+    answer 200 with correct predictions."""
+    from lightgbm_tpu.serving import ModelRegistry, serve_http
+
+    bst, X = _serving_model(rng)
+    reg = ModelRegistry(queue_cap=8)
+    reg.load("default", bst.model_to_string())
+    httpd = serve_http(reg, port=0, block=False)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def post(body, timeout=30):
+        req = urllib.request.Request(
+            base + "/v1/score", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    try:
+        out = post({"rows": X[:5].tolist(), "queue": True})
+        np.testing.assert_allclose(out["pred"], bst.predict(X[:5]),
+                                   rtol=1e-5, atol=1e-6)
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"rows": X[:5].tolist(), "queue": True,
+                  "deadline_ms": 1e-4})
+        assert ei.value.code == 504
+        assert json.loads(ei.value.read())["error_kind"] == "deadline"
+
+        # hold the device for 0.6 s; queue a second request behind it;
+        # the third exceeds the row cap -> 503 + Retry-After
+        faultinject.arm("device_put:1:delay:0.6")
+        results = {}
+
+        def bg(key, rows):
+            results[key] = post({"rows": rows.tolist(), "queue": True})
+
+        ta = threading.Thread(target=bg, args=("a", X[:4]))
+        ta.start()
+        time.sleep(0.2)
+        tb = threading.Thread(target=bg, args=("b", X[:6]))
+        tb.start()
+        time.sleep(0.1)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"rows": X[:4].tolist(), "queue": True})
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert json.loads(ei.value.read())["error_kind"] == "overloaded"
+        ta.join(timeout=30)
+        tb.join(timeout=30)
+        np.testing.assert_allclose(results["a"]["pred"],
+                                   bst.predict(X[:4]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(results["b"]["pred"],
+                                   bst.predict(X[:6]),
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=5)
+
+
+# =============================================== MicroBatcher close()
+class _StubForest:
+    @staticmethod
+    def _check_width(X):
+        return None
+
+
+class _StubDispatcher:
+    """Minimal dispatcher double: lets close() semantics be tested
+    without device calls, including a worker wedged mid-score."""
+
+    name = "stub"
+    buckets = (8,)
+    forest = _StubForest()
+
+    def __init__(self, gate=None):
+        self.gate = gate  # worker blocks here when set
+
+    def score_raw(self, X):
+        if self.gate is not None:
+            self.gate.wait()
+        return np.zeros((1, X.shape[0]), np.float32)
+
+
+def test_microbatcher_close_drains_queue():
+    """Regression: close() on a healthy batcher DRAINS the queue (the
+    worker finishes pending work on the way out); submits after close
+    fast-fail with ShutdownError instead of queueing forever."""
+    from lightgbm_tpu.serving import MicroBatcher
+
+    mb = MicroBatcher(_StubDispatcher(), max_delay_s=0.2)
+    futs = [mb.submit(np.zeros((2, 3), np.float32)) for _ in range(3)]
+    mb.close()
+    for fut in futs:
+        assert fut.result(timeout=5).shape == (2, 1)
+    with pytest.raises(ShutdownError):
+        mb.submit(np.zeros((1, 3), np.float32))
+
+
+@pytest.mark.slow
+def test_microbatcher_close_fails_pending_when_wedged():
+    """close() with the worker wedged inside a device call: queued
+    futures are failed with ShutdownError after the join timeout —
+    a shutdown must never leave callers blocked on Future.result()."""
+    from lightgbm_tpu.serving import MicroBatcher
+
+    gate = threading.Event()
+    mb = MicroBatcher(_StubDispatcher(gate=gate), max_delay_s=0.001)
+    in_flight = mb.submit(np.zeros((2, 3), np.float32))
+    time.sleep(0.2)  # worker is now blocked inside score_raw
+    queued = mb.submit(np.zeros((2, 3), np.float32))
+    try:
+        mb.close()  # join times out (worker wedged), sweeps the queue
+        with pytest.raises(ShutdownError):
+            queued.result(timeout=1)
+        assert not in_flight.done()  # coalesced work is never cancelled
+    finally:
+        gate.set()  # release the wedged worker thread
+
+
+# ======================================================== heartbeats
+def test_heartbeat_and_health_report(tmp_path):
+    d = str(tmp_path)
+    hb = HeartbeatWriter(d, rank=1, interval_s=60.0)
+    hb.start()
+    try:
+        beats = read_heartbeats(d)
+        assert beats[1]["rank"] == 1 and beats[1]["seq"] == 0
+        rep = health_report(d, expected=3)
+        assert rep["alive"] == [1]
+        assert rep["missing"] == [0, 2]
+        assert not rep["healthy"]
+    finally:
+        hb.stop()
+    # the final beat marks a clean shutdown: alive even when old
+    rep = health_report(d, expected=2, stale_after_s=0.0,
+                        now=time.time() + 1000)
+    assert 1 in rep["alive"]
+
+    # a rank whose beats stopped mid-run classifies as stale
+    with open(heartbeat_path(d, 0), "w") as f:
+        json.dump({"rank": 0, "pid": 1, "seq": 4,
+                   "t_unix": time.time() - 1000, "final": False}, f)
+    rep = health_report(d, expected=2, stale_after_s=30.0)
+    assert rep["stale"] == [0] and not rep["healthy"]
+
+    # torn/alien heartbeat files are skipped, not fatal
+    (tmp_path / "heartbeat_rank00002.json").write_text("{torn")
+    assert 2 not in read_heartbeats(d)

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Chaos suite runner (docs/RESILIENCE.md): every test marked `chaos` —
+# deterministic fault injection (resilience/faultinject.py) driving
+# crash-at-round-N + resume bit-match, SIGKILL'd subprocess resume,
+# serving deadline expiry / queue admission 503s / device-fault host
+# fallback, and anomaly rollback recovery.
+#
+# The fast chaos tests also run inside the tier-1 gate (they carry no
+# `slow` mark); this entry point runs the FULL chaos set, including the
+# slow SIGKILL subprocess test, in isolation:
+#
+#   tools/chaos.sh                 # all chaos tests
+#   tools/chaos.sh -k sigkill      # extra pytest args pass through
+#
+# Forced onto the CPU backend: fault injection and recovery must work
+# exactly when the accelerator is the thing that broke.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+  -p no:cacheprovider "$@"
