@@ -112,10 +112,13 @@ def probe_backend(timeout_s: float, retries: int = 1) -> str:
     """Run a tiny jit in a subprocess; return its platform or 'cpu'.
 
     The axon tunnel wedges transiently (multi-minute init hangs that
-    clear on a later attempt — observed rounds 2-4), so a failed probe
-    is retried with exponential backoff (10s, 20s, 40s, ... capped at
-    120s) rather than condemning the run to the CPU fallback on first
-    strike."""
+    clear on a later attempt — observed rounds 2-4), so a probe that
+    FAILS (nonzero rc, import error) is retried with exponential
+    backoff (10s, 20s, 40s, ... capped at 120s). A probe that TIMES
+    OUT fails fast to the CPU fallback instead: a second identical
+    wait on a wedged tunnel just burns another full timeout_s of the
+    driver budget with the same outcome (BENCH_r05 spent 620 s on two
+    serial 300 s timeouts before its first measurement)."""
     for attempt in range(1, retries + 1):
         try:
             r = subprocess.run(
@@ -133,8 +136,9 @@ def probe_backend(timeout_s: float, retries: int = 1) -> str:
         except subprocess.TimeoutExpired:
             sys.stderr.write(
                 f"[bench] backend probe {attempt}/{retries} timed out "
-                f"({timeout_s}s)\n"
+                f"({timeout_s}s) — failing fast to cpu\n"
             )
+            return "cpu"
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(
                 f"[bench] backend probe {attempt}/{retries} failed: {e}\n"
@@ -295,7 +299,17 @@ def main() -> None:
         platform = "cpu"
     else:
         # probe even when JAX_PLATFORMS=axon (the default env): the probe
-        # exists precisely to detect a dead TPU tunnel before hanging
+        # exists precisely to detect a dead TPU tunnel before hanging.
+        # When the env already NAMES a backend, the caller has made the
+        # placement decision — the probe only needs to confirm the
+        # tunnel is alive, so probe ONCE with a short timeout instead
+        # of the full multi-attempt schedule (BENCH_r05 burned 620 s on
+        # two serial 300 s timeouts before measuring anything).
+        if os.environ.get("JAX_PLATFORMS"):
+            probe_timeout = float(
+                os.environ.get("BENCH_PROBE_FAST_TIMEOUT", 60)
+            )
+            probe_retries = 1
         t0 = time.time()
         platform = probe_backend(probe_timeout, probe_retries)
         sys.stderr.write(

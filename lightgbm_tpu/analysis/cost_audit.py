@@ -229,6 +229,36 @@ def audit_cost(summary: CostSummary, budget: Optional[Dict[str, Any]],
     )
 
 
+# cross-entry DROP contracts (ISSUE 12 satellite): entry -> baseline
+# whose MEASURED bytes_accessed it must strictly undercut. The
+# headroomed per-entry budget only stops regressions; this pins the
+# claimed improvement itself — the int-packed default path (3 integer
+# channels) must access fewer bytes than the 5-channel bf16x2 path it
+# replaces, or the perf story is fiction.
+# The fused pair carries the structural proof: the interpreted kernel
+# lowering accumulates nat_ch channel rows, so 3 vs 5 channels is a
+# guaranteed gap. The serial pair is NOT pinned — the CPU einsum
+# fallback collapses bf16x2 to 3 channels before contracting, leaving
+# only a sliver of difference there (the rounds_serial_packed entry
+# still budget-ratchets on its own).
+_DROP_PAIRS: Dict[str, str] = {"hist_round_fused": "hist_round_fused_bf16"}
+
+
+def audit_bytes_drop(name: str, got: int, base: str,
+                     ref: int) -> Contract:
+    """`name` must access strictly fewer compiled bytes than `base`
+    (both measured THIS run — no stale budget on either side)."""
+    ok = got < ref
+    return Contract(
+        f"bytes_drop_vs_{base}", ok,
+        (f"{_fmt_bytes(got)} < {base}'s {_fmt_bytes(ref)} "
+         f"({got / ref:.0%})" if ok else
+         f"{_fmt_bytes(got)} does NOT undercut {base}'s "
+         f"{_fmt_bytes(ref)} — the narrow-channel path stopped being "
+         "narrower"),
+    )
+
+
 # -------------------------------------------------------------- runner
 def load_budgets() -> Dict[str, Dict[str, int]]:
     if _BUDGET_PATH.exists():
@@ -257,13 +287,30 @@ def run_cost_audits(names: Optional[Sequence[str]] = None
             )
     budgets = load_budgets()
     out: List[AuditResult] = []
-    for name, entry in ENTRIES.items():
-        if names is not None and name not in names:
-            continue
-        summary = compile_entry(name)
-        out.append(audit_cost(
-            summary, budgets.get(name), name, wire_dtype=entry.wire_dtype
-        ))
+    summaries: Dict[str, CostSummary] = {}
+    audited = [n for n in ENTRIES if names is None or n in names]
+    for name in audited:
+        summaries[name] = compile_entry(name)
+    for name in audited:
+        res = audit_cost(
+            summaries[name], budgets.get(name), name,
+            wire_dtype=ENTRIES[name].wire_dtype,
+        )
+        base = _DROP_PAIRS.get(name)
+        if base is not None:
+            # the baseline is measured this run even when the caller
+            # filtered it out — a drop contract against a stale number
+            # proves nothing
+            if base not in summaries:
+                summaries[base] = compile_entry(base)
+            c = audit_bytes_drop(
+                name, summaries[name].bytes_accessed,
+                base, summaries[base].bytes_accessed,
+            )
+            res = AuditResult(
+                name, res.ok and c.ok, res.contracts + [c], 0,
+            )
+        out.append(res)
     return out
 
 

@@ -6,8 +6,9 @@ construction only, a couple of seconds on CPU) and asserts contracts
 that every perf/correctness regression so far would have tripped:
 
 - the quantized wire: `reduce_scatter` present, every wire operand
-  exactly `QUANT_WIRE_DTYPE` (int32 today; ROADMAP 3a's int16 flip is
-  that one constant + a cost_audit wire-bytes budget refresh);
+  exactly `QUANT_WIRE_DTYPE` (int16 — the narrowest exact payload,
+  histogram.rs_wire_dtype; a second entry pins the int32 step-down
+  when the int16 bound trips);
 - the overflow gate (ADVICE r5, histogram.rs_exact_ok): past the
   2^31 global / 2^24 per-shard exactness bounds the wire must VANISH
   and the f32 psum fallback take over;
@@ -324,10 +325,45 @@ def _trace_rounds_serial():
     )
 
 
-def _trace_hist_round():
+def _trace_rounds_serial_packed():
+    """The int-packed DEFAULT training path (ISSUE 12 tentpole):
+    serial rounds grower with quant=True / 256 internal levels and a
+    gh_scale input — exactly what boosting._grow_int_packed builds when
+    tpu_hist_dtype resolves to int16. 3 histogram channels instead of
+    bf16x2's 5; cost_audit pins the bytes-accessed DROP vs
+    rounds_serial."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import Config
+    from ..learner.grower import GrowerSpec, make_split_params
+    from ..learner.rounds import grow_tree_rounds
+
+    L, B, G, N = 31, 64, 8, 4096
+    spec = GrowerSpec(num_leaves=L, num_bins=B, max_depth=-1,
+                      rounds_slots=8, quant=True, quant_levels=256,
+                      has_cat=False)
+    params = make_split_params(Config({}))
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+    return jax.make_jaxpr(
+        lambda b, nb, numb, mono, cat, g, h, m, fm, p, sc:
+        grow_tree_rounds(
+            b, nb, numb, mono, cat, g, h, m, fm, p, spec, gh_scale=sc
+        )
+    )(
+        mk((G, N), jnp.int32), mk((G,), jnp.int32), mk((G,), jnp.int32),
+        mk((G,), jnp.int32), mk((G,), jnp.bool_), mk((N,), jnp.float32),
+        mk((N,), jnp.float32), mk((N,), jnp.float32), mk((G,), jnp.bool_),
+        params, mk((2,), jnp.float32),
+    )
+
+
+def _trace_hist_round(quant: bool = True):
     """The fused partition+histogram pallas kernel (_round_kernel) —
     traced abstractly; pallas_call jaxpr construction is platform-free
-    even though compilation needs a TPU."""
+    even though compilation needs a TPU. quant=True is the 3-channel
+    int-packed layout, quant=False the 5-channel bf16x2 hi/lo split —
+    cost_audit pins the bytes-accessed DROP between the pair."""
     import jax
     import jax.numpy as jnp
 
@@ -337,7 +373,7 @@ def _trace_hist_round():
     mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
     return jax.make_jaxpr(
         lambda b, g, p, prm, coh: hist_round(
-            b, g, p, prm, coh, S, B, quant=True
+            b, g, p, prm, coh, S, B, quant=quant
         )
     )(
         mk((G, N), jnp.int32), mk((8, N), jnp.float32), mk((N,), jnp.int32),
@@ -386,14 +422,18 @@ class _Entry(NamedTuple):
 
 # the quantized data-parallel histogram wire dtype (reference halves
 # socket bytes with int16/int32 packing, include/LightGBM/bin.h:63-81;
-# our wire is int32 today — ROADMAP 3a flips this to int16, then
-# `python -m lightgbm_tpu.analysis --refresh-budgets` proves the
-# wire-bytes halving and pins it)
-QUANT_WIRE_DTYPE = "int32"
+# ROADMAP 3a landed: histogram.rs_wire_dtype picks the NARROWEST exact
+# payload — int16 while the mesh-wide hessian worst case stays under
+# 2^15, int32 up to the 2^31/2^24 bounds, f32 psum past those. The
+# wire-bytes halving is pinned by cost_audit's exact wire budget.)
+QUANT_WIRE_DTYPE = "int16"
 
-# levels=16, 2048 local rows: 2048*8*16 = 262k < 2^31 and 2048*16 =
-# 32k < 2^24 — the rs wire must engage
-_RS_OK = dict(quant=True, levels=16, local_rows=2048)
+# levels=16, 128 local rows: 128*8*16 = 16384 < 2^15 — the int16 wire
+# must engage (256 local rows would hit exactly 2^15 and step down)
+_RS_OK = dict(quant=True, levels=16, local_rows=128)
+# levels=16, 2048 local rows: 2048*8*16 = 262k >= 2^15 but < 2^31 and
+# 2048*16 = 32k < 2^24 — the wire steps down to int32, not psum
+_RS_INT32 = dict(quant=True, levels=16, local_rows=2048)
 # levels=256, 131072 local rows: 131072*256 = 33.5M > 2^24 — the
 # per-shard exactness bound trips and the wire must fall back to psum
 _RS_OVERFLOW = dict(quant=True, levels=256, local_rows=131072)
@@ -412,6 +452,20 @@ ENTRIES: Dict[str, _Entry] = {
         "quantized data-parallel grower inside the exactness bounds: "
         f"{QUANT_WIRE_DTYPE} reduce-scatter wire end to end",
         wire_dtype=QUANT_WIRE_DTYPE,
+    ),
+    "rounds_quant_rs_int32": _Entry(
+        lambda: _trace_rounds_dp(**_RS_INT32),
+        lambda budget: [
+            has_prim("reduce_scatter",
+                     "the wire survives past the int16 bound"),
+            wire_dtype("int32"),
+            no_host_callbacks(),
+            no_f64(),
+            within_budget(budget),
+        ],
+        "quantized grower past the int16 bound but inside int32 "
+        "exactness: wire steps down to int32, not psum",
+        wire_dtype="int32",
     ),
     "rounds_quant_rs_overflow": _Entry(
         lambda: _trace_rounds_dp(**_RS_OVERFLOW),
@@ -435,6 +489,17 @@ ENTRIES: Dict[str, _Entry] = {
         ],
         "single-device rounds grower: pure device loop",
     ),
+    "rounds_serial_packed": _Entry(
+        _trace_rounds_serial_packed,
+        lambda budget: [
+            no_host_callbacks(),
+            no_f64(),
+            lacks_prim("reduce_scatter", "no mesh, no collective"),
+            within_budget(budget),
+        ],
+        "int-packed default path (tpu_hist_dtype=int16): 3-channel "
+        "integer histograms + scale recovery, single device",
+    ),
     "hist_round_fused": _Entry(
         _trace_hist_round,
         lambda budget: [
@@ -443,7 +508,20 @@ ENTRIES: Dict[str, _Entry] = {
             no_f64(),
             within_budget(budget),
         ],
-        "fused partition+histogram kernel (pallas_hist._round_kernel)",
+        "fused partition+histogram kernel (pallas_hist._round_kernel), "
+        "3-channel int-packed layout",
+        pallas_interpret=True,
+    ),
+    "hist_round_fused_bf16": _Entry(
+        lambda: _trace_hist_round(quant=False),
+        lambda budget: [
+            has_prim("pallas_call", "the fused _round_kernel"),
+            no_host_callbacks(),
+            no_f64(),
+            within_budget(budget),
+        ],
+        "fused round kernel, 5-channel bf16x2 hi/lo layout — the "
+        "baseline the int-packed pair must undercut",
         pallas_interpret=True,
     ),
     "serving_forest": _Entry(

@@ -245,6 +245,11 @@ class GBDT:
         self._force_sync = False
         self._force_sync_reason: Optional[str] = None
         self._init_iters = 0  # loaded iterations under continued training
+        # resolved histogram channel layout (tpu_hist_dtype policy);
+        # overwritten below when a train_set selects the real path
+        self.hist_dtype = "bf16x2"
+        self._hist_levels = 0
+        self._int_packed = False
 
         if train_set is None:
             return  # prediction-only booster (model loaded from file)
@@ -254,13 +259,17 @@ class GBDT:
         warn_unimplemented(config)
         # true-gradient leaf renewal bypasses the grower's monotone
         # interval clamp and path smoothing — refuse the combination
-        # rather than silently violate a declared constraint
-        self._quant_renew_ok = True
-        if config.use_quantized_grad and config.quant_train_renew_leaf and (
+        # rather than silently violate a declared constraint. The same
+        # guard gates the internal int-packed path's always-on renewal
+        # (_grow_maybe_quantized).
+        self._true_renew_ok = not (
             config.path_smooth > 0
             or (train_set.monotone_constraints is not None
                 and np.any(train_set.monotone_constraints != 0))
-        ):
+        )
+        self._quant_renew_ok = True
+        if config.use_quantized_grad and config.quant_train_renew_leaf \
+                and not self._true_renew_ok:
             self._quant_renew_ok = False
             log.warning(
                 "quant_train_renew_leaf is disabled: true-gradient leaf "
@@ -508,11 +517,11 @@ class GBDT:
             and not n_forced
         )
         mode = config.tpu_growth_mode
+        try:
+            on_tpu = jax.devices()[0].platform == "tpu"
+        except Exception:  # noqa: BLE001
+            on_tpu = False
         if mode == "auto":
-            try:
-                on_tpu = jax.devices()[0].platform == "tpu"
-            except Exception:  # noqa: BLE001
-                on_tpu = False
             use_rounds = on_tpu and rounds_ok
         else:
             use_rounds = mode == "rounds"
@@ -523,6 +532,23 @@ class GBDT:
                     "falling back to exact sequential growth"
                 )
                 use_rounds = False
+        # histogram channel-dtype policy (tpu_hist_dtype, ISSUE 12): on
+        # the rounds path the DEFAULT (unquantized-API) trainer also
+        # discretizes g/h per round to narrow integer levels and rides
+        # the 3-channel slot-packed histogram kernels; f32 scales are
+        # recovered before gain/leaf math and leaf outputs are renewed
+        # from the true gradients, so the public semantics stay put.
+        from .learner.quantize import resolve_hist_dtype
+
+        self.hist_dtype, self._hist_levels, hd_warn = resolve_hist_dtype(
+            config.tpu_hist_dtype, config.use_quantized_grad,
+            config.num_grad_quant_bins, use_rounds, on_tpu=on_tpu,
+        )
+        if hd_warn and config.set_explicitly("tpu_hist_dtype"):
+            log.warning(hd_warn)
+        # int-packed channels on the default path (no public quant API)
+        int_packed = self._hist_levels > 0
+        self._int_packed = int_packed
         self.spec = GrowerSpec(
             num_leaves=config.num_leaves,
             num_bins=train_set.max_num_bin,
@@ -545,22 +571,31 @@ class GBDT:
             # waste width on candidate-limited rounds) so 25 stays
             rounds_slots=(
                 min(config.tpu_round_slots
-                    or (48 if config.use_quantized_grad else 25),
+                    or (48 if (config.use_quantized_grad or int_packed)
+                        else 25),
                     config.num_leaves)
                 if use_rounds else 0
             ),
             # int levels must be bf16-exact (integers <= 256); larger
-            # num_grad_quant_bins rides the dequantized 5-channel path
-            quant=bool(use_rounds and config.use_quantized_grad
-                       and config.num_grad_quant_bins <= 256),
+            # num_grad_quant_bins rides the dequantized 5-channel path.
+            # The internal hist_dtype policy (int_packed) reuses the same
+            # 3-channel integer machinery with its own level count.
+            quant=bool(use_rounds
+                       and ((config.use_quantized_grad
+                             and config.num_grad_quant_bins <= 256)
+                            or int_packed)),
             # levels within int8 range (g <= bins/2, h <= bins): the
             # kernel runs s8 x s8 -> s32 on the MXU. rounds.py further
             # gates on histogram.int8_oh_shift finding a SWAR scale
             # whose worst-case s32 cell sum cannot overflow (ADVICE r4)
-            quant_int8=bool(use_rounds and config.use_quantized_grad
-                            and config.num_grad_quant_bins <= 127),
+            quant_int8=bool(use_rounds
+                            and ((config.use_quantized_grad
+                                  and config.num_grad_quant_bins <= 127)
+                                 or (int_packed
+                                     and self._hist_levels <= 127))),
             quant_levels=(config.num_grad_quant_bins
-                          if config.use_quantized_grad else 0),
+                          if config.use_quantized_grad
+                          else self._hist_levels),
             mono_mode=mono_mode,
             voting_k=config.top_k if use_voting else 0,
             extra_trees=use_extra,
@@ -694,10 +729,12 @@ class GBDT:
             w = jnp.ones(self.train_set.num_rows_padded(), jnp.float32)
         return alpha, w
 
-    def _quantize(self, gk, hk, it, k):
+    def _quantize(self, gk, hk, it, k, num_bins=None):
         """use_quantized_grad: discretize this tree's gradients to
         INTEGER levels + scales (gradient_discretizer.cpp
-        DiscretizeGradients); traceable."""
+        DiscretizeGradients); traceable. `num_bins` overrides the
+        public quant level count (the internal hist_dtype policy passes
+        its own 256/127)."""
         import jax
 
         from .learner.quantize import discretize_gradients_int
@@ -707,8 +744,34 @@ class GBDT:
             jax.random.key(c.data_random_seed), it * self.num_class + k
         )
         return discretize_gradients_int(
-            gk, hk, key, c.num_grad_quant_bins, c.stochastic_rounding
+            gk, hk, key, num_bins or c.num_grad_quant_bins,
+            c.stochastic_rounding,
         )
+
+    def _grow_int_packed(self, gk, hk, mask, feat_mask, valid, it, k,
+                         bins=None, tables=None):
+        """Internal hist_dtype=int16/int8 policy (ISSUE 12): the default
+        API path discretizes g/h to self._hist_levels integer levels,
+        accumulates 3 narrow channels through the rounds grower's
+        spec.quant machinery (scales recovered before gain math), and
+        renews leaf outputs from the TRUE gradients so the public
+        semantics stay within stochastic-rounding noise of bf16x2."""
+        gq, hq, scale = self._quantize(gk, hk, it, k,
+                                       num_bins=self._hist_levels)
+        arrays, row_leaf = self._grow(
+            gq, hq, mask, feat_mask, valid, it, k, gh_scale=scale,
+            bins=bins, tables=tables,
+        )
+        if self._true_renew_ok:
+            from .learner.quantize import renew_leaf_with_true_gradients
+
+            arrays = arrays._replace(
+                leaf_value=renew_leaf_with_true_gradients(
+                    arrays.leaf_value, row_leaf, gk, hk, mask,
+                    self.params, self.spec.num_leaves,
+                )
+            )
+        return arrays, row_leaf
 
     def _grow_maybe_quantized(self, gk, hk, mask, feat_mask, valid, it, k,
                               bins=None, tables=None):
@@ -718,6 +781,11 @@ class GBDT:
         with the true gradients afterward."""
         c = self.config
         if not c.use_quantized_grad:
+            if self._int_packed and self.spec.quant:
+                return self._grow_int_packed(
+                    gk, hk, mask, feat_mask, valid, it, k,
+                    bins=bins, tables=tables,
+                )
             return self._grow(gk, hk, mask, feat_mask, valid, it, k,
                               bins=bins, tables=tables)
         gq, hq, scale = self._quantize(gk, hk, it, k)

@@ -205,7 +205,18 @@ _PARAMS: Dict[str, _P] = {
     # channels x 25 slots filling the MXU's 128-row matmul axis; 42
     # under use_quantized_grad's 3 integer channels)
     "tpu_round_slots": (0, int, (), _nonneg),
-    "tpu_hist_dtype": ("float32", str, (), None),
+    # internal histogram-channel dtype policy (docs/DESIGN_DECISIONS.md
+    # "Histogram numerics"): "bf16x2" = 5-channel hi/lo split (exact
+    # f32 sums); "int16"/"int8" = discretize g/h per round to 256/127
+    # integer levels and accumulate 3 narrow channels (scales recovered
+    # before gain/leaf math, true-gradient leaf renewal keeps the
+    # public semantics); "auto" = int16 on the rounds growth path,
+    # bf16x2 otherwise. "float32" is accepted as a legacy synonym for
+    # bf16x2. Under use_quantized_grad the quantized-API levels govern
+    # and this param is ignored.
+    "tpu_hist_dtype": ("auto", str, ("hist_dtype",),
+                       lambda v: v in ("auto", "float32", "bf16x2",
+                                       "int16", "int8")),
     # USE_DEBUG split validation (serial_tree_learner.h:174 CheckSplit):
     # recompute leaf counts/hessian sums from the partition each
     # iteration and fatal on drift; forces the sync loop
@@ -524,9 +535,6 @@ _UNIMPLEMENTED = (
      "per-feature split-gain multipliers are not implemented"),
     ("predict_disable_shape_check", False,
      "predict always validates the feature count"),
-    ("tpu_hist_dtype", "float32",
-     "histogram dtype is chosen automatically (f32; int32 under "
-     "use_quantized_grad)"),
     ("time_out", 120,
      "the cluster handshake timeout is managed by jax.distributed"),
 )

@@ -116,7 +116,10 @@ class _ObsHooks:
         from .boosting import FUSED_ROUND_PHASE
 
         rec: Dict[str, Any] = {
-            "round": self.round_offset + i, "t_unix": time.time()
+            "round": self.round_offset + i, "t_unix": time.time(),
+            # resolved histogram channel layout — numerics provenance
+            # per round (the int-packed path changes per-tree math)
+            "hist_dtype": getattr(self._gbdt, "hist_dtype", None),
         }
         if j < len(self._step_durs):
             rec["phases"] = {
@@ -139,7 +142,8 @@ class _ObsHooks:
 
     def eager_round(self, i: int, evals, iter_seconds: float) -> None:
         rec: Dict[str, Any] = {
-            "round": self.round_offset + i, "t_unix": time.time()
+            "round": self.round_offset + i, "t_unix": time.time(),
+            "hist_dtype": getattr(self._gbdt, "hist_dtype", None),
         }
         drained = self.recorder.drain_phases()
         if drained:

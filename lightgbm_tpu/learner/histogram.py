@@ -57,6 +57,15 @@ def _use_pallas() -> bool:
         return False
 
 
+def _use_int4_oh() -> bool:
+    """Opt-in for the experimental nibble-SWAR (int4) one-hot on the
+    int8 histogram path (pallas_hist._swar_onehot4 has the evaluation
+    verdict — kept behind LGBM_TPU_INT4_OH=1)."""
+    import os
+
+    return os.environ.get("LGBM_TPU_INT4_OH", "") == "1"
+
+
 def build_gh8(grad: jax.Array, hess: jax.Array, count: jax.Array) -> jax.Array:
     """(N,) grad/hess/count (already masked) -> (8, N) bf16x2-split channels."""
     g_hi = grad.astype(jnp.bfloat16).astype(jnp.float32)
@@ -236,11 +245,16 @@ def hist_nat_slots(
     # per-channel-count cap guards the slot axis.
     per_slot = nat_ch * F * num_bins * 4
     s_cap, budget = _round_caps(nat_ch)
+    use_i8 = bool(int8 and quant)
+    # the persistent one-hot iota scratch is part of the kernel's VMEM
+    # block schedule — charge it against the scoped budget
+    budget = max(budget - _oh_scratch_bytes(num_bins, use_i8), 0)
     s_max = max(1, min(budget // max(per_slot, 1), s_cap))
     if (_use_pallas() and N % HIST_BLK == 0 and N >= HIST_BLK
             and per_slot <= budget):
         from .pallas_hist import hist_nat_tpu
 
+        int4 = bool(use_i8 and _use_int4_oh())
         parts = []
         for c0 in range(0, num_slots, s_max):
             sc = min(s_max, num_slots - c0)
@@ -252,7 +266,7 @@ def hist_nat_slots(
             out = hist_nat_tpu(
                 bins_fm, gh8, local, sc, num_bins,
                 interpret=_interpret_pallas(), nat_ch=nat_ch,
-                int8=bool(int8 and quant), oh_shift=oh_shift,
+                int8=use_i8, oh_shift=oh_shift, int4=int4,
             )  # (sc*nat_ch, F*B)
             o = out.reshape(sc, nat_ch, F, num_bins)
             if quant:
@@ -302,11 +316,34 @@ def rs_exact_ok(local_rows: int, n_ranks: int, quant_levels: int) -> bool:
     False sends the caller to the f32 psum fallback (lossy-by-design,
     like the reference's f32 histogram mode). Static ints only — the
     decision is a trace-time constant, never a device value."""
+    return rs_wire_dtype(local_rows, n_ranks, quant_levels) is not None
+
+
+def rs_wire_dtype(local_rows: int, n_ranks: int,
+                  quant_levels: int) -> "str | None":
+    """Narrowest exact dtype for the reduce-scatter histogram wire
+    (ROADMAP 3a; the reference's int16/int32 socket reducers,
+    include/LightGBM/bin.h:63-81).
+
+    - "int16" when the mesh-wide hessian-channel worst case
+      local_rows * n_ranks * quant_levels stays under 2^15 — the
+      per-rank partial AND the reduced global sum both fit int16, so
+      the wire payload halves with no loss (the count channel is
+      bounded by global rows, which the same product dominates);
+    - "int32" under the wider bounds: global worst case under 2^31,
+      and per-rank sums within f32's exact-integer range 2^24 (ranks
+      accumulate in f32 before the integer cast);
+    - None sends the caller to the f32 psum fallback (lossy-by-design,
+      like the reference's f32 histogram mode).
+
+    Static ints only — a trace-time constant, never a device value."""
     levels = max(int(quant_levels), 1)
-    return (
-        local_rows * n_ranks * levels < 2 ** 31
-        and local_rows * levels < 2 ** 24
-    )
+    if local_rows * n_ranks * levels < 2 ** 15:
+        return "int16"
+    if (local_rows * n_ranks * levels < 2 ** 31
+            and local_rows * levels < 2 ** 24):
+        return "int32"
+    return None
 
 
 def _round_caps(nat_ch: int) -> tuple:
@@ -317,19 +354,46 @@ def _round_caps(nat_ch: int) -> tuple:
         else (64, int(5.7 * 2 ** 20))
 
 
-def can_hist_round(n_rows: int, num_slots: int, num_feat: int,
-                   num_bins: int, quant: bool) -> bool:
-    """Static gate for the fused round kernel (pallas path only, no
-    slot chunking — the partition decision must see every slot)."""
+def _oh_scratch_bytes(num_bins: int, int8: bool) -> int:
+    """VMEM bytes of the kernels' persistent one-hot iota scratch
+    (pallas_hist._oh_iota_shape): part of the explicit block schedule,
+    so the slot-budget math must charge for it."""
+    rows = -(-num_bins // 4) if int8 else num_bins
+    return rows * HIST_BLK * 4
+
+
+# the fused round kernel may chunk its slot axis (each chunk re-streams
+# the bins/gh blocks, so the fan-out is capped — past this the
+# non-fused path's separate passes are no worse)
+_ROUND_MAX_CHUNKS = 4
+
+
+def _round_s_max(num_feat: int, num_bins: int, quant: bool,
+                 int8: bool) -> int:
     nat_ch = 3 if quant else NAT_CH
     s_cap, budget = _round_caps(nat_ch)
+    budget = max(budget - _oh_scratch_bytes(num_bins, int8), 0)
     per_slot = nat_ch * num_feat * num_bins * 4
+    if per_slot > budget:
+        return 0
+    return max(1, min(budget // max(per_slot, 1), s_cap))
+
+
+def can_hist_round(n_rows: int, num_slots: int, num_feat: int,
+                   num_bins: int, quant: bool,
+                   int8: bool = False) -> bool:
+    """Static gate for the fused round kernel (pallas path only). The
+    slot axis may be CHUNKED (hist_round composes the disjoint
+    per-chunk partition updates), so the gate requires one chunk to
+    fit the scoped-VMEM schedule and caps the re-stream fan-out at
+    _ROUND_MAX_CHUNKS."""
+    s_max = _round_s_max(num_feat, num_bins, quant, int8)
     return (
         _use_pallas()
         and n_rows % HIST_BLK == 0
         and n_rows >= HIST_BLK
-        and per_slot <= budget  # one slot must fit the scoped VMEM
-        and num_slots <= max(1, min(budget // max(per_slot, 1), s_cap))
+        and s_max > 0
+        and num_slots <= _ROUND_MAX_CHUNKS * s_max
     )
 
 
@@ -349,19 +413,36 @@ def hist_round(
 ):
     """Fused round step -> ((S, 3, F, B) f32 histograms, (N,) new
     row->leaf). Callers must check can_hist_round first; histogram
-    sums are exact (integer s32 on the int8 path, rescaled here)."""
+    sums are exact (integer s32 on the int8 path, rescaled here).
+
+    When S exceeds the one-chunk VMEM schedule, the slot axis is
+    chunked: every chunk sees the ORIGINAL row->leaf vector and only
+    its own slots' split params, so the per-chunk partition deltas
+    touch disjoint rows (memberships are disjoint across slots) and
+    compose by summation — pleaf_new = pleaf + sum(pleaf_chunk -
+    pleaf). Histogram chunks concatenate along the slot axis."""
     from .pallas_hist import hist_round_tpu, _swar_divisor
 
     F, N = bins_fm.shape
     nat_ch = 3 if quant else NAT_CH
-    out, pl_new = hist_round_tpu(
-        bins_fm, gh8, pleaf, params, col_onehot, num_slots, num_bins,
-        nat_ch, int8=bool(int8 and quant), oh_shift=oh_shift, efb=efb,
-        cat_mask=cat_mask, interpret=_interpret_pallas(),
-    )
-    if int8 and quant:
-        out = out.astype(jnp.float32) * (1.0 / _swar_divisor(oh_shift))
-    o = out.reshape(num_slots, nat_ch, F, num_bins)
+    use_int8 = bool(int8 and quant)
+    s_max = _round_s_max(F, num_bins, quant, use_int8) or num_slots
+    outs = []
+    pl_new = None
+    for c0 in range(0, num_slots, s_max):
+        sc = min(s_max, num_slots - c0)
+        out_c, pl_c = hist_round_tpu(
+            bins_fm, gh8, pleaf, params[c0:c0 + sc],
+            col_onehot[c0:c0 + sc], sc, num_bins, nat_ch,
+            int8=use_int8, oh_shift=oh_shift, efb=efb,
+            cat_mask=None if cat_mask is None else cat_mask[c0:c0 + sc],
+            interpret=_interpret_pallas(),
+        )
+        outs.append(out_c.reshape(sc, nat_ch, F, num_bins))
+        pl_new = pl_c if pl_new is None else pl_new + (pl_c - pleaf)
+    o = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    if use_int8:
+        o = o.astype(jnp.float32) * (1.0 / _swar_divisor(oh_shift))
     if quant:
         return o, pl_new
     o3 = jnp.stack([o[:, 0] + o[:, 1], o[:, 2] + o[:, 3], o[:, 4]], axis=1)

@@ -37,7 +37,8 @@ from jax.experimental.pallas import tpu as pltpu
 from .histogram import CH, HIST_BLK, NAT_CH
 
 
-def _accum_hist_nt(bins_ref, lhs, out_ref, *, F, B, blk, dt, acc_t):
+def _accum_hist_nt(bins_ref, lhs, out_ref, *, F, B, blk, dt, acc_t,
+                   iota_bT=None):
     """Shared accumulate loop: one NT matmul per feature, the one-hot
     built TRANSPOSED (B, blk) directly from the bins tile's native
     (F, blk) layout — the former per-block (blk, F) int32 transpose
@@ -45,8 +46,13 @@ def _accum_hist_nt(bins_ref, lhs, out_ref, *, F, B, blk, dt, acc_t):
     stream. Grouping features into wider matmuls was tried and measured
     SLOWER (lane-axis concat of one-hots cost more than the larger
     matmul saved: 4.75 -> 3.71 trees/s end to end; 3D->2D reshapes onto
-    the lane axis don't lower in Mosaic at all)."""
-    iota_bT = lax.broadcasted_iota(jnp.int32, (B, blk), 0)
+    the lane axis don't lower in Mosaic at all).
+
+    `iota_bT` passes the (B, blk) row-iota from a VMEM scratch buffer
+    written once at grid step 0 (see _oh_iota_init) so the constant is
+    block-resident instead of re-materialized every step x feature."""
+    if iota_bT is None:
+        iota_bT = lax.broadcasted_iota(jnp.int32, (B, blk), 0)
     for f in range(F):
         ohT = (bins_ref[f : f + 1, :] == iota_bT).astype(dt)  # (B, blk)
         out_ref[:, f * B : (f + 1) * B] += lax.dot_general(
@@ -55,9 +61,38 @@ def _accum_hist_nt(bins_ref, lhs, out_ref, *, F, B, blk, dt, acc_t):
         )
 
 
-def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref,
+def _oh_iota_shape(B: int, blk: int, int8: bool,
+                   int4: bool = False) -> tuple:
+    """Shape of the persistent one-hot iota scratch (one VMEM buffer
+    per kernel invocation, written at grid step 0 and reused by every
+    later step): the compare path persists the (B, blk) row iota, the
+    byte-SWAR path the packed (ceil(B/4), blk) byte iota, the
+    nibble-SWAR (int4) path a (2*ceil(B/8), blk) stack of the packed
+    nibble iota and the per-row hi-block index."""
+    if int8 and int4:
+        return (2 * (-(-B // 8)), blk)
+    if int8:
+        return (-(-B // 4), blk)
+    return (B, blk)
+
+
+def _oh_iota_init(shape: tuple, int8: bool, int4: bool = False):
+    """Value for the persistent iota scratch (see _oh_iota_shape)."""
+    if int8 and int4:
+        half = shape[0] // 2
+        bg = lax.broadcasted_iota(jnp.int32, (half, shape[1]), 0)
+        iota_nib = (bg & 1) * _SWAR4_M8 + 0x76543210
+        return jnp.concatenate([iota_nib, bg >> 1], axis=0)
+    bg = lax.broadcasted_iota(jnp.int32, shape, 0)
+    if int8:
+        return bg * (4 * _SWAR_REP) + 0x03020100
+    return bg
+
+
+def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref, iota_ref,
                 *, F: int, B: int, blk: int, S: int, nat_ch: int,
-                int8: bool = False, oh_shift: int = 0):
+                int8: bool = False, oh_shift: int = 0,
+                int4: bool = False):
     """Slot-packed natural-order histogram: rows carry a slot id; the
     weight matrix W packs (slot x channel) onto the MXU's M axis —
     W[(s, c), r] = gh[c, r] * (slot[r] == s) — so one (S*nat_ch, blk) @
@@ -82,7 +117,9 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref,
     @pl.when(i == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
+        iota_ref[...] = _oh_iota_init(iota_ref.shape, int8, int4)
 
+    iota = iota_ref[...]  # VMEM-persistent one-hot iota (step-invariant)
     slot = slot_ref[0, :]  # (blk,) int32
     gh = gh_ref[...]  # (CH, blk) f32; rows 0..nat_ch-1 are live
     iota_s = lax.broadcasted_iota(jnp.int32, (S, blk), 0)
@@ -96,8 +133,14 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref,
         ).astype(jnp.int8)
         # SWAR one-hot (see _swar_onehot): 1.65x the compare+cast rate
         # on the VPU-bound end; sums come out scaled by the byte value
+        # (nibble value on the experimental int4 variant)
         for f in range(F):
-            oh = _swar_onehot(bins_ref[f:f + 1, :], B, blk, oh_shift)
+            if int4:
+                oh = _swar_onehot4(bins_ref[f:f + 1, :], B, blk,
+                                   iota2=iota)
+            else:
+                oh = _swar_onehot(bins_ref[f:f + 1, :], B, blk, oh_shift,
+                                  iota_p=iota)
             out_ref[:, f * B:(f + 1) * B] += lax.dot_general(
                 W, oh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.int32,
@@ -108,7 +151,7 @@ def _nat_kernel(bins_ref, gh_ref, slot_ref, out_ref,
     W = (sl[:, None, :] * g5[None, :, :]).reshape(S * nat_ch, blk)
 
     _accum_hist_nt(bins_ref, W, out_ref, F=F, B=B, blk=blk,
-                   dt=jnp.bfloat16, acc_t=jnp.float32)
+                   dt=jnp.bfloat16, acc_t=jnp.float32, iota_bT=iota)
 
 
 def _swar_divisor(oh_shift: int) -> float:
@@ -117,10 +160,20 @@ def _swar_divisor(oh_shift: int) -> float:
     return -128.0 if oh_shift == 0 else float(128 >> oh_shift)
 
 
+# nibble-SWAR (int4) one-hot marker: 0x8 per nibble, always positive
+# after the even/odd plane split (see _swar_onehot4)
+_SWAR4_DIVISOR = 8.0
+
+# the histogram grid walks row blocks accumulating into grid-constant
+# output blocks: steps are NOT parallelizable, tell Mosaic so instead
+# of letting it infer (the chip-resident schedule contract, ISSUE 12)
+_ARBITRARY = pltpu.TPUCompilerParams(dimension_semantics=("arbitrary",))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_slots", "num_bins", "blk", "interpret", "nat_ch",
-                     "int8", "oh_shift"),
+                     "int8", "oh_shift", "int4"),
 )
 def hist_nat_tpu(
     bins_fm: jax.Array,  # (F, N) int32, natural row order
@@ -133,9 +186,13 @@ def hist_nat_tpu(
     nat_ch: int = NAT_CH,
     int8: bool = False,
     oh_shift: int = 0,
+    int4: bool = False,
 ) -> jax.Array:
     """(S*nat_ch, F*B) f32 packed per-slot channel histograms (exact
-    integer sums computed in s32 when int8)."""
+    integer sums computed in s32 when int8). `int4` (int8 path only,
+    LGBM_TPU_INT4_OH=1) swaps the byte-SWAR one-hot for the nibble
+    variant: 8 bins per i32 lane, marker 8 — see _swar_onehot4 for the
+    evaluation verdict."""
     F, N = bins_fm.shape
     assert N % blk == 0, (N, blk)
     assert gh8.shape == (CH, N), gh8.shape
@@ -144,7 +201,7 @@ def hist_nat_tpu(
     nb = N // blk
     out = pl.pallas_call(
         functools.partial(_nat_kernel, F=F, B=B, blk=blk, S=S, nat_ch=nat_ch,
-                          int8=int8, oh_shift=oh_shift),
+                          int8=int8, oh_shift=oh_shift, int4=int4),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((F, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
@@ -157,11 +214,16 @@ def hist_nat_tpu(
         out_shape=jax.ShapeDtypeStruct(
             (S * nat_ch, F * B), jnp.int32 if int8 else jnp.float32
         ),
+        scratch_shapes=[
+            pltpu.VMEM(_oh_iota_shape(B, blk, int8, int4), jnp.int32),
+        ],
+        compiler_params=_ARBITRARY,
         interpret=interpret,
     )(bins_fm, gh8, slot.reshape(1, N))
     if not int8:
         return out
-    return out.astype(jnp.float32) * (1.0 / _swar_divisor(oh_shift))
+    div = _SWAR4_DIVISOR if int4 else _swar_divisor(oh_shift)
+    return out.astype(jnp.float32) * (1.0 / div)
 
 
 _SWAR_REP = 0x01010101
@@ -169,7 +231,7 @@ _SWAR_M7 = 0x7F7F7F7F
 _SWAR_M8 = -2139062144  # 0x80808080 as i32
 
 
-def _swar_onehot(bins_row, B: int, blk: int, oh_shift: int):
+def _swar_onehot(bins_row, B: int, blk: int, oh_shift: int, iota_p=None):
     """(1, blk) i32 bin values -> (B, blk) s8 one-hot, 4 bins per i32.
 
     The straight `bins == iota` compare + s8 cast costs ~4.4 ms per
@@ -193,10 +255,15 @@ def _swar_onehot(bins_row, B: int, blk: int, oh_shift: int):
 
     oh_shift trades VPU ops for s32 headroom: 0 keeps bytes at +/-128
     (fastest, sums scaled 128x), 4 shifts to +/-8 (two extra ops,
-    16x more accumulation headroom)."""
+    16x more accumulation headroom).
+
+    `iota_p` passes the packed byte iota from a VMEM scratch written at
+    grid step 0 (_oh_iota_init) instead of re-materializing the
+    constant every step x feature."""
     B4 = -(-B // 4)  # pad to a byte multiple; extra rows sliced off
-    bg = lax.broadcasted_iota(jnp.int32, (B4, blk), 0)
-    iota_p = bg * (4 * _SWAR_REP) + 0x03020100
+    if iota_p is None:
+        bg = lax.broadcasted_iota(jnp.int32, (B4, blk), 0)
+        iota_p = bg * (4 * _SWAR_REP) + 0x03020100
     t = (bins_row * _SWAR_REP) ^ iota_p
     z = ~(((t & _SWAR_M7) + _SWAR_M7) | t) & _SWAR_M8
     if oh_shift:
@@ -207,10 +274,65 @@ def _swar_onehot(bins_row, B: int, blk: int, oh_shift: int):
     return oh if 4 * B4 == B else oh[:B, :]
 
 
+_SWAR4_REP = 0x11111111
+_SWAR4_M7 = 0x77777777
+_SWAR4_M8 = -2004318072  # 0x88888888 as i32
+
+
+def _swar_onehot4(bins_row, B: int, blk: int, iota2=None):
+    """(1, blk) i32 bin values -> (B, blk) s8 one-hot via NIBBLE (int4)
+    SWAR packing: EIGHT bins per i32 lane (ISSUE 12 evaluation).
+
+    Packed row j covers bins 8j..8j+7, which always share one 16-bin
+    block (hi nibble j >> 1), so equality splits into a nibble zero
+    test on the LOW nibble against the packed nibble iota (row j even:
+    0x76543210, odd: 0xFEDCBA98) AND a whole-lane hi-block match:
+
+        t = ((bins & 15) * 0x11111111) ^ iota_nib
+        z = ~(((t & 0x77777777) + 0x77777777) | t) & 0x88888888
+        z = where(bins >> 4 == j >> 1, z, 0)
+
+    (the same carry-free masked test as the byte variant — (t & 7) + 7
+    cannot carry across nibbles). Marker 0x8 per matching nibble.
+
+    EVALUATION VERDICT (kept opt-in, LGBM_TPU_INT4_OH=1): this
+    toolchain's pltpu.bitcast cannot widen i32 -> 8 x i4 (it rejects
+    the 4-bit element reinterpret), so the unpack degrades to an
+    even/odd nibble-plane split — two masked shifts, two i32 -> s8
+    byte bitcasts and a sublane interleave. The halved one-hot VMEM
+    footprint survives only up to that unpack; the extra VPU work eats
+    most of the packing win, and the MXU dot still runs s8. The
+    nibble TEST itself (3 ops for 8 bins vs 3 ops for 4) is the part
+    worth keeping if a true i4 reinterpret lands.
+
+    `iota2` passes the (2*ceil(B/8), blk) VMEM scratch stack
+    [iota_nib; row_hi] (_oh_iota_init). Marker is always 8 (the s32
+    headroom of the byte path's oh_shift=4), divisor _SWAR4_DIVISOR."""
+    B8 = -(-B // 8)
+    if iota2 is None:
+        bg = lax.broadcasted_iota(jnp.int32, (B8, blk), 0)
+        iota_nib = (bg & 1) * _SWAR4_M8 + 0x76543210
+        row_hi = bg >> 1
+    else:
+        iota_nib = iota2[:B8, :]
+        row_hi = iota2[B8:, :]
+    lo = (bins_row & 15) * _SWAR4_REP
+    t = lo ^ iota_nib
+    z = ~(((t & _SWAR4_M7) + _SWAR4_M7) | t) & _SWAR4_M8
+    z = jnp.where((bins_row >> 4) == row_hi, z, 0)
+    # nibble-plane split: even bins live in low nibbles, odd in high;
+    # each plane is a byte-plane the toolchain CAN bitcast to s8
+    ze = pltpu.bitcast(z & 0x0F0F0F0F, jnp.int8)  # (4*B8, blk) bins 2r
+    zo = pltpu.bitcast((z >> 4) & 0x0F0F0F0F, jnp.int8)  # bins 2r+1
+    oh = jnp.stack([ze, zo], axis=1).reshape(8 * B8, blk)
+    return oh if 8 * B8 == B else oh[:B, :]
+
+
 def _round_kernel(
     params_ref, coh_ref, cat_ref, bins_ref, gh_ref, pleaf_ref,  # inputs
     out_ref, pl_out_ref,  # outputs
-    *, F: int, B: int, blk: int, S: int, nat_ch: int, int8: bool,
+    *scratch,  # persistent one-hot iota buffers (mode-dependent)
+    F: int, B: int, blk: int, S: int, nat_ch: int, int8: bool,
     oh_shift: int, efb: bool, has_cat: bool,
 ):
     """Fused round step: partition decision + slot-packed histograms
@@ -244,11 +366,28 @@ def _round_kernel(
     gh channels are zero and whose new id is L: harmless by
     construction, same argument as the XLA path in rounds.py)."""
     i = pl.program_id(0)
+    # scratch layout (_round_scratch_shapes): int8 -> one byte-SWAR
+    # iota (shared by the bins one-hots and the cat one-hot); bf16
+    # with cat -> compare iota + byte-SWAR iota; bf16 without -> just
+    # the compare iota. All written once at step 0, VMEM-resident after.
+    if int8:
+        iota_swar_ref, = scratch
+        iota_cmp_ref = None
+    elif has_cat:
+        iota_cmp_ref, iota_swar_ref = scratch
+    else:
+        iota_cmp_ref, = scratch
+        iota_swar_ref = None
 
     @pl.when(i == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
+        if iota_cmp_ref is not None:
+            iota_cmp_ref[...] = _oh_iota_init(iota_cmp_ref.shape, False)
+        if iota_swar_ref is not None:
+            iota_swar_ref[...] = _oh_iota_init(iota_swar_ref.shape, True)
 
+    iota_swar = None if iota_swar_ref is None else iota_swar_ref[...]
     pleaf = pleaf_ref[...]  # (1, blk) i32
     gh = gh_ref[...]  # (CH, blk) f32
     sel = params_ref[:, 0:1]  # (S, 1) i32
@@ -284,7 +423,8 @@ def _round_kernel(
         is_cat_s = params_ref[:, 10:11] != 0  # (S, 1)
         fb_own = jnp.sum(jnp.where(memb, fb, 0.0), axis=0,
                          keepdims=True)  # (1, blk) f32 integer-valued
-        ohfb = _swar_onehot(fb_own.astype(jnp.int32), B, blk, 7)  # 0/1 s8
+        ohfb = _swar_onehot(fb_own.astype(jnp.int32), B, blk, 7,
+                            iota_p=iota_swar)  # 0/1 s8
         hits = lax.dot_general(
             cat_ref[...], ohfb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32,
@@ -303,7 +443,8 @@ def _round_kernel(
         W = (side_i[:, None, :] * g32[None, :, :]).reshape(
             S * nat_ch, blk).astype(jnp.int8)
         for f in range(F):
-            oh = _swar_onehot(bins_ref[f:f + 1, :], B, blk, oh_shift)
+            oh = _swar_onehot(bins_ref[f:f + 1, :], B, blk, oh_shift,
+                              iota_p=iota_swar)
             out_ref[:, f * B:(f + 1) * B] += lax.dot_general(
                 W, oh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.int32,
@@ -313,7 +454,8 @@ def _round_kernel(
         gb = gh[:nat_ch, :].astype(jnp.bfloat16)
         W = (sideb[:, None, :] * gb[None, :, :]).reshape(S * nat_ch, blk)
         _accum_hist_nt(bins_ref, W, out_ref, F=F, B=B, blk=blk,
-                       dt=jnp.bfloat16, acc_t=jnp.float32)
+                       dt=jnp.bfloat16, acc_t=jnp.float32,
+                       iota_bT=iota_cmp_ref[...])
 
 
 @functools.partial(
@@ -348,6 +490,18 @@ def hist_round_tpu(
     has_cat = cat_mask is not None
     if cat_mask is None:
         cat_mask = jnp.zeros((S, num_bins), jnp.int8)
+    # persistent one-hot iota scratch (see _round_kernel): part of the
+    # kernel's explicit VMEM block schedule, accounted against the
+    # scoped budget by histogram._round_caps callers
+    if int8:
+        scratch = [pltpu.VMEM(_oh_iota_shape(num_bins, blk, True),
+                              jnp.int32)]
+    else:
+        scratch = [pltpu.VMEM(_oh_iota_shape(num_bins, blk, False),
+                              jnp.int32)]
+        if has_cat:
+            scratch.append(pltpu.VMEM(_oh_iota_shape(num_bins, blk, True),
+                                      jnp.int32))
     out, pl_new = pl.pallas_call(
         functools.partial(
             _round_kernel, F=F, B=num_bins, blk=blk, S=S, nat_ch=nat_ch,
@@ -373,6 +527,8 @@ def hist_round_tpu(
                                  jnp.int32 if int8 else jnp.float32),
             jax.ShapeDtypeStruct((1, N), jnp.int32),
         ],
+        scratch_shapes=scratch,
+        compiler_params=_ARBITRARY,
         interpret=interpret,
     )(params, col_onehot, cat_mask, bins_fm, gh8, pleaf.reshape(1, N))
     return out, pl_new.reshape(N)

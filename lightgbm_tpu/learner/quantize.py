@@ -22,6 +22,65 @@ pre-generated random value table with a rotating start offset
 
 from __future__ import annotations
 
+from typing import Optional, Tuple
+
+# internal discretization levels per hist_dtype policy: int16 channels
+# carry 256 levels (g in [-128, 128], h in [0, 256] — bf16-exact ints
+# and far inside the int16 accumulation range), int8 carries 127 so the
+# slot kernel can run s8 x s8 -> s32 on the MXU (histogram.int8_oh_shift
+# bounds the SWAR scale against s32 cell overflow)
+HIST_DTYPE_LEVELS = {"int16": 256, "int8": 127}
+
+
+def resolve_hist_dtype(
+    requested: str,
+    use_quantized_grad: bool,
+    num_grad_quant_bins: int,
+    use_rounds: bool,
+    on_tpu: bool = True,
+) -> Tuple[str, int, Optional[str]]:
+    """Resolve the tpu_hist_dtype policy to the histogram channel
+    layout one tree actually accumulates with.
+
+    Returns (resolved, internal_levels, warning):
+
+    - resolved: "bf16x2" | "int16" | "int8" — the channel layout;
+    - internal_levels: discretization levels for the INTERNAL int-packed
+      default path (0 when bf16x2 or when use_quantized_grad supplies
+      its own levels);
+    - warning: a message when an explicit request had to fall back.
+
+    Under use_quantized_grad the quantized-API levels govern: the
+    resolved name just reports what that path does (int8/int16 slot
+    channels on the rounds grower, dequantized bf16x2 otherwise).
+    Off the rounds growth path the int-packed channels do not exist
+    (the sequential growers accumulate f32 hi/lo), so explicit
+    int16/int8 requests fall back to bf16x2 with a warning.
+
+    "auto" flips to int16 only when use_rounds AND on_tpu: off-chip
+    rounds runs (tests, CPU fallbacks) keep the bit-exact bf16x2
+    layout — same contract as tpu_growth_mode=auto, which keeps CPU
+    runs reference-exact. An EXPLICIT int16/int8 request on the rounds
+    path is honored on any backend (that is how the parity suites
+    exercise the packed channels off-chip).
+    """
+    if use_quantized_grad:
+        if use_rounds and num_grad_quant_bins <= 127:
+            return "int8", 0, None
+        if use_rounds and num_grad_quant_bins <= 256:
+            return "int16", 0, None
+        return "bf16x2", 0, None
+    req = "bf16x2" if requested == "float32" else requested
+    if req == "auto":
+        req = "int16" if (use_rounds and on_tpu) else "bf16x2"
+    if req in HIST_DTYPE_LEVELS and not use_rounds:
+        return "bf16x2", 0, (
+            f"tpu_hist_dtype={requested} needs the rounds growth path "
+            "(tpu_growth_mode=rounds, or auto on TPU hardware); "
+            "falling back to bf16x2 channels"
+        )
+    return req, HIST_DTYPE_LEVELS.get(req, 0), None
+
 
 def discretize_gradients_int(
     grad,

@@ -51,6 +51,7 @@ from .histogram import (
     int8_oh_shift,
     root_sums,
     rs_exact_ok,
+    rs_wire_dtype,
 )
 from .grower import (
     GrowerSpec,
@@ -162,7 +163,7 @@ def grow_tree_rounds(
     # the kernel too: the row's own split-column bin gets a
     # single-feature SWAR one-hot contracted against the per-slot
     # category masks.
-    use_fused = can_hist_round(N, S, G, Bc, spec.quant)
+    use_fused = can_hist_round(N, S, G, Bc, spec.quant, int8=use_int8)
     # ---- reduce-scatter histogram wire (VERDICT r4 item 9): the full
     # psum ships every rank the whole f32 histogram; the reference
     # ships INTEGER histograms through ReduceScatter with per-rank
@@ -189,6 +190,11 @@ def grow_tree_rounds(
     if use_rs:
         Gp = -(-G // n_rs) * n_rs  # feature axis padded to the mesh
         Gn = Gp // n_rs  # features owned per rank
+        # narrowest exact wire payload (ROADMAP 3a / ISSUE 12 satellite):
+        # int16 halves the off-chip reduce-scatter bytes whenever the
+        # worst-case integer sums fit (histogram.rs_wire_dtype); the
+        # jaxpr/cost auditors pin the chosen dtype and the exact bytes
+        wire_dt = jnp.dtype(rs_wire_dtype(N, n_rs, spec.quant_levels))
 
         def _pad_tables(t, fill):
             return jnp.concatenate(
@@ -208,12 +214,13 @@ def grow_tree_rounds(
 
         def rs_hist(h):
             """(..., G, Bc) local f32 integer sums -> this rank's owned
-            (..., Gn, Bc) block, reduced over the mesh in int32."""
+            (..., Gn, Bc) block, reduced over the mesh in the narrowest
+            exact integer dtype (int16 when the sums fit, else int32)."""
             if Gp != G:
                 pad = [(0, 0)] * (h.ndim - 2) + [(0, Gp - G), (0, 0)]
                 h = jnp.pad(h, pad)
             out = lax.psum_scatter(
-                h.astype(jnp.int32), ax,
+                h.astype(wire_dt), ax,
                 scatter_dimension=h.ndim - 2, tiled=True,
             )
             return out.astype(jnp.float32)

@@ -26,6 +26,7 @@ _CORE_KEYS = (
     "task", "objective", "boosting", "num_iterations", "num_leaves",
     "learning_rate", "max_bin", "tree_learner", "num_class",
     "use_quantized_grad", "tpu_growth_mode", "tpu_growth_rounds",
+    "tpu_hist_dtype",
 )
 
 
@@ -140,6 +141,13 @@ def build_manifest(config: Optional[Any] = None,
                 "best_iteration": getattr(booster, "best_iteration", -1),
                 "num_class": getattr(
                     getattr(booster, "_gbdt", None), "num_class", 1
+                ),
+                # RESOLVED histogram channel layout (may differ from
+                # the requested tpu_hist_dtype — e.g. auto, or the
+                # off-rounds-path fallback): the numerics provenance a
+                # reproduction needs
+                "hist_dtype": getattr(
+                    getattr(booster, "_gbdt", None), "hist_dtype", None
                 ),
             }
         except Exception:  # noqa: BLE001 — model summary is best-effort

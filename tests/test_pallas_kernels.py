@@ -372,3 +372,85 @@ def test_nat_grower_with_interpreted_kernel(interp):
     lv_fb, rl_fb = run()
     np.testing.assert_allclose(lv_interp, lv_fb, atol=5e-4)
     assert (rl_interp == rl_fb).mean() > 0.999
+
+
+# ------------------------------------------- int4 SWAR one-hot (ISSUE 12)
+@pytest.mark.parametrize("B4", [16, 24, 32])
+def test_hist_nat_int4_interpret_exact(interp, monkeypatch, data, B4):
+    """Nibble-SWAR one-hot (8 bins per i32 lane, LGBM_TPU_INT4_OH=1):
+    integer sums must equal the f32 fallback bit-for-bit, including bin
+    counts that are not multiples of 8 (the packed-row padding)."""
+    N, F, _, _, _ = data
+    from lightgbm_tpu.learner.histogram import (
+        build_gh8_quant,
+        hist_nat_slots,
+    )
+
+    monkeypatch.setenv("LGBM_TPU_INT4_OH", "1")
+    rs = np.random.RandomState(12)
+    bins = jnp.asarray(rs.randint(0, B4, (F, N)).astype(np.int32))
+    gq = jnp.asarray(rs.randint(-8, 9, N).astype(np.float32))
+    hq = jnp.asarray(rs.randint(0, 17, N).astype(np.float32))
+    gh8q = build_gh8_quant(gq, hq, jnp.ones(N, jnp.float32))
+    S = 6
+    slot = jnp.asarray(rs.randint(0, S + 1, N).astype(np.int32))
+    out = hist_nat_slots(bins, gh8q, slot, S, B4, quant=True, int8=True,
+                         oh_shift=0)
+    ref = _hist_nat_fallback(bins, gh8q, slot, S, B4, quant=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_swar4_onehot_unpack_ordering():
+    """The nibble-plane unpack (even/odd split + byte bitcasts + stack
+    interleave) must place packed row j's nibble m at bin 8*j + m — a
+    swapped interleave would score every odd bin into its even
+    neighbor. pltpu.bitcast only evaluates inside a kernel, so the
+    helper runs under an interpreted pallas_call."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    from lightgbm_tpu.learner.pallas_hist import _swar_onehot4
+
+    B, blk = 16, 256
+    rs = np.random.RandomState(13)
+    bins_row = jnp.asarray(rs.randint(0, B, (1, blk)).astype(np.int32))
+
+    def kernel(bins_ref, out_ref):
+        out_ref[...] = _swar_onehot4(bins_ref[...], B, blk)
+
+    oh = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, blk), jnp.int8),
+        interpret=True,
+    )(bins_row)
+    expect = (np.arange(B)[:, None]
+              == np.asarray(bins_row)[0][None, :]).astype(np.int8) * 8
+    np.testing.assert_array_equal(np.asarray(oh), expect)
+
+
+# -------------------------------------- chunked fused round (ISSUE 12)
+def test_fused_round_chunked_matches_fallback(interp, monkeypatch):
+    """When S exceeds the one-chunk VMEM schedule, hist_round re-streams
+    the slot axis and composes disjoint per-chunk partition deltas; the
+    chunked kernel must reproduce the XLA path's tree exactly. Forced
+    by shrinking _round_s_max to 3 (rounds_slots=8 -> 3 chunks)."""
+    import os
+    import sys
+
+    import jax
+
+    # learner/__init__ re-exports the histogram FUNCTION, shadowing the
+    # submodule on attribute import — go through sys.modules
+    hist_mod = sys.modules["lightgbm_tpu.learner.histogram"]
+    monkeypatch.setattr(hist_mod, "_round_s_max",
+                        lambda *a, **k: 3)
+    kw = dict(rounds_slots=8, has_cat=False, quant=True,
+              quant_levels=4)
+    fused = _grow_case(kw, quant=True)
+    os.environ["LGBM_TPU_PALLAS_INTERPRET"] = "0"
+    jax.clear_caches()
+    fb = _grow_case(kw, quant=True)
+    np.testing.assert_allclose(fused[0], fb[0], atol=5e-4)
+    assert (fused[1] == fb[1]).mean() > 0.999
+    np.testing.assert_array_equal(fused[2], fb[2])
+    np.testing.assert_array_equal(fused[3], fb[3])

@@ -197,7 +197,10 @@ def test_entry_table_records_quant_wire_dtype():
     from lightgbm_tpu.analysis.jaxpr_audit import ENTRIES, QUANT_WIRE_DTYPE
 
     assert ENTRIES["rounds_quant_rs"].wire_dtype == QUANT_WIRE_DTYPE
-    assert QUANT_WIRE_DTYPE == "int32"  # today; ROADMAP 3a flips this
+    # ROADMAP 3a flipped in round 12 (rs_wire_dtype narrowest-exact
+    # policy); the int32 step-down regime keeps its own pinned entry
+    assert QUANT_WIRE_DTYPE == "int16"
+    assert ENTRIES["rounds_quant_rs_int32"].wire_dtype == "int32"
 
 
 def test_host_callback_contract_red_to_green():
