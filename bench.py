@@ -200,13 +200,13 @@ def write_run_manifest(params) -> None:
     try:
         from lightgbm_tpu.obs.manifest import write_manifest
 
-        # durable path next to the BENCH artifacts (like bench_serve's
-        # run_manifest_serve_rNN.json), NOT the tmp partial dir — the
-        # stamped link must still resolve after tmp cleanup. Fixed
-        # name (latest run wins); the run_id inside ties it to its
-        # artifact.
+        # manifest lives under the tmp run dir (BENCH_RUN_DIR — the
+        # same treatment bench partials got): writing it at the repo
+        # root once left a stale run_manifest_bench.json checked in.
+        # The run_id inside ties it to its artifact; BENCH_MANIFEST_OUT
+        # overrides when a durable copy is wanted.
         mpath = os.environ.get("BENCH_MANIFEST_OUT") or os.path.join(
-            REPO, "run_manifest_bench.json"
+            os.path.dirname(_PARTIAL_PATH), "run_manifest_bench.json"
         )
         write_manifest(mpath, config=dict(params), extra={
             "bench": "train", "run_id": _STATE["run_id"],

@@ -5,20 +5,41 @@ training BENCH files, so serving performance is tracked
 round-over-round exactly like training throughput (ROADMAP item 4; the
 artifact always carries "qps", "p50_ms", "p99_ms").
 
-What it measures: a model is trained in-process on synthetic data,
-loaded into the serving ModelRegistry (bucket-padded dispatcher,
-warmed), then T threads fire R score requests of B rows each through
-``registry.predict`` — the same entry point both serving transports
-call — and per-request wall latencies are recorded. The registry's own
-LatencyStats ring (what ``/metrics`` and the stats op report) rides
-along in "stats" so the benchmark's numbers and the observability
-numbers can be cross-checked.
+Three phases, one artifact — the comparison is same-run so the two
+sides share the trained model, the process, and the machine state:
+
+1. **baseline** — the model in a single-replica ModelRegistry, one
+   closed-loop client calling ``registry.predict`` directly (no
+   queue).  This is the floor a naive deployment gets.
+2. **loaded** (the headline "qps"/"p99_ms") — the same model behind
+   ``replicas`` predictor replicas with the continuous-batching
+   MicroBatcher front (``registry.batcher``); pipelined async clients
+   keep a window of futures outstanding so requests coalesce into
+   shared padded device calls.  A fixed probe batch is scored through
+   BOTH paths and compared bit-for-bit ("bit_identical") — the speedup
+   must not come from answering a different question.
+   "speedup_x" = loaded/baseline QPS.
+3. **fleet** — the same booster loaded under ``fleet_size`` names into
+   a ModelFleet whose HBM ``capacity`` is smaller than the fleet, then
+   scored round-robin so LRU paging churns; per-model p99 and the
+   pager's counters land in "fleet".
+
+The dispatcher's own observability (queue depth, padded-row waste,
+coalesce ratio — what /metrics exports) is snapshotted per phase into
+"dispatcher" so the benchmark numbers and the metrics numbers can be
+cross-checked.
 
 Env overrides: BENCH_SERVE_TRAIN_ROWS, BENCH_SERVE_FEATURES,
 BENCH_SERVE_TREES, BENCH_SERVE_LEAVES, BENCH_SERVE_REQUESTS,
-BENCH_SERVE_BATCH, BENCH_SERVE_THREADS, BENCH_SERVE_QUEUE (also drive
-the microbatch-coalescing path), BENCH_SERVE_OUT (explicit output
-path), BENCH_SERVE_DIR (output directory, default: repo root).
+BENCH_SERVE_BATCH (rows per request — 1 by default: the online-request
+shape continuous batching exists for), BENCH_SERVE_THREADS
+(loaded-phase clients), BENCH_SERVE_WINDOW (outstanding futures per
+client), BENCH_SERVE_BASE_REQUESTS, BENCH_SERVE_REPLICAS,
+BENCH_SERVE_FLEET_MODELS, BENCH_SERVE_FLEET_CAPACITY,
+BENCH_SERVE_FLEET_REQUESTS, BENCH_SERVE_OUT (explicit output path),
+BENCH_SERVE_DIR (output directory, default: repo root),
+BENCH_RUN_DIR / BENCH_MANIFEST_OUT (run-manifest location — the
+manifest lives under the tmp run dir, never the repo root).
 """
 
 from __future__ import annotations
@@ -28,6 +49,7 @@ import json
 import os
 import re
 import sys
+import tempfile
 import threading
 import time
 
@@ -59,43 +81,28 @@ def _pct(sorted_vals, p: float) -> float:
     return sorted_vals[i]
 
 
-def run_bench() -> dict:
-    import jax
+def _lat_summary(latencies, wall: float, batch: int) -> dict:
+    lat = sorted(latencies)
+    done = len(lat)
+    return {
+        "qps": round(done / wall, 2) if wall > 0 else 0.0,
+        "p50_ms": round(1e3 * _pct(lat, 0.50), 4),
+        "p95_ms": round(1e3 * _pct(lat, 0.95), 4),
+        "p99_ms": round(1e3 * _pct(lat, 0.99), 4),
+        "mean_ms": round(1e3 * sum(lat) / done, 4) if lat else 0.0,
+        "rows_per_sec": round(done * batch / wall, 1) if wall > 0 else 0.0,
+        "requests": done,
+        "wall_s": round(wall, 3),
+    }
 
-    import lightgbm_tpu as lgb
-    from lightgbm_tpu.serving import ModelRegistry
 
-    train_rows = _env_int("BENCH_SERVE_TRAIN_ROWS", 20000)
-    n_feat = _env_int("BENCH_SERVE_FEATURES", 16)
-    n_trees = _env_int("BENCH_SERVE_TREES", 50)
-    n_leaves = _env_int("BENCH_SERVE_LEAVES", 31)
-    n_requests = _env_int("BENCH_SERVE_REQUESTS", 200)
-    batch = _env_int("BENCH_SERVE_BATCH", 64)
-    n_threads = _env_int("BENCH_SERVE_THREADS", 4)
-    use_queue = _env_int("BENCH_SERVE_QUEUE", 0) != 0
-
-    rs = np.random.RandomState(0)
-    X = rs.randn(train_rows, n_feat).astype(np.float32)
-    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
-    ds = lgb.Dataset(X, label=y, free_raw_data=False)
-    t0 = time.perf_counter()
-    bst = lgb.train(
-        {"objective": "binary", "num_leaves": n_leaves, "verbosity": -1},
-        ds, num_boost_round=n_trees,
-    )
-    train_s = time.perf_counter() - t0
-
-    registry = ModelRegistry(warmup=True)
-    registry.load("bench", bst, num_features=n_feat)
-
-    req = rs.randn(batch, n_feat).astype(np.float32)
-    # warmup outside the timed window (compiles + first-dispatch costs)
-    for _ in range(3):
-        registry.predict("bench", req, via_queue=use_queue)
-
+def _fire(predict, n_requests: int, n_threads: int, batch: int,
+          n_feat: int) -> dict:
+    """Closed-loop clients: n_threads threads each fire their share of
+    n_requests calls to ``predict(rows)``; returns the latency summary."""
     latencies: list = []
     lock = threading.Lock()
-    per_thread = max(n_requests // n_threads, 1)
+    per_thread = max(n_requests // max(n_threads, 1), 1)
 
     def worker(seed: int) -> None:
         wrs = np.random.RandomState(seed)
@@ -103,7 +110,7 @@ def run_bench() -> dict:
         for _ in range(per_thread):
             rows = wrs.randn(batch, n_feat).astype(np.float32)
             t = time.perf_counter()
-            registry.predict("bench", rows, via_queue=use_queue)
+            predict(rows)
             mine.append(time.perf_counter() - t)
         with lock:
             latencies.extend(mine)
@@ -117,24 +124,199 @@ def run_bench() -> dict:
         t.start()
     for t in threads:
         t.join()
-    wall = time.perf_counter() - t0
+    return _lat_summary(latencies, time.perf_counter() - t0, batch)
 
-    done = len(latencies)
-    lat = sorted(latencies)
+
+def _fire_pipelined(submit, n_requests: int, n_threads: int, window: int,
+                    batch: int, n_feat: int) -> dict:
+    """Pipelined async clients: each thread keeps up to ``window``
+    futures outstanding (submit without blocking, collect the oldest
+    once the window fills) so the continuous-batching queue stays fed.
+    Latency is submit→completion per request."""
+    latencies: list = []
+    lock = threading.Lock()
+    per_thread = max(n_requests // max(n_threads, 1), 1)
+
+    def worker(seed: int) -> None:
+        wrs = np.random.RandomState(seed)
+        mine: list = []
+        outstanding: list = []
+
+        def collect(pair) -> None:
+            t_submit, fut = pair
+            fut.result()
+            mine.append(time.perf_counter() - t_submit)
+
+        for _ in range(per_thread):
+            rows = wrs.randn(batch, n_feat).astype(np.float32)
+            outstanding.append((time.perf_counter(), submit(rows)))
+            if len(outstanding) >= window:
+                collect(outstanding.pop(0))
+        for pair in outstanding:
+            collect(pair)
+        with lock:
+            latencies.extend(mine)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(n_threads)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return _lat_summary(latencies, time.perf_counter() - t0, batch)
+
+
+def _serve_counters() -> dict:
+    """Summed lgbmtpu_serve_* counter values from the metrics registry
+    (labels collapsed) — diffed around a phase to attribute traffic."""
+    from lightgbm_tpu.obs.metrics import default_registry
+
+    out: dict = {}
+    for name, by_label in default_registry().snapshot().items():
+        if name.startswith(("lgbmtpu_serve_", "lgbmtpu_fleet_")):
+            out[name] = sum(by_label.values())
+    return out
+
+
+def _dispatcher_view(before: dict, after: dict, rows_scored: int) -> dict:
+    """The observability view of one phase: coalescing efficiency and
+    padding waste derived from the /metrics counters."""
+    d = {k: after.get(k, 0.0) - before.get(k, 0.0)
+         for k in after}
+    drains = d.get("lgbmtpu_serve_coalesced_batch_rows_count", 0.0)
+    coalesced = d.get("lgbmtpu_serve_coalesced_requests_total", 0.0)
+    padded = d.get("lgbmtpu_serve_padded_rows_total", 0.0)
+    calls = d.get("lgbmtpu_serve_bucket_dispatch_total", 0.0)
+    return {
+        "device_calls": int(calls),
+        "coalesced_requests": int(coalesced),
+        "coalesce_ratio": round(coalesced / drains, 3) if drains else 0.0,
+        "padded_rows": int(padded),
+        "padding_waste_frac": round(
+            padded / (padded + rows_scored), 4
+        ) if rows_scored else 0.0,
+        "queue_depth": after.get("lgbmtpu_serve_queue_depth", 0.0),
+    }
+
+
+def run_bench() -> dict:
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import ModelFleet, ModelRegistry
+
+    train_rows = _env_int("BENCH_SERVE_TRAIN_ROWS", 20000)
+    n_feat = _env_int("BENCH_SERVE_FEATURES", 16)
+    n_trees = _env_int("BENCH_SERVE_TREES", 50)
+    n_leaves = _env_int("BENCH_SERVE_LEAVES", 31)
+    n_requests = _env_int("BENCH_SERVE_REQUESTS", 8192)
+    base_requests = _env_int("BENCH_SERVE_BASE_REQUESTS", 256)
+    batch = _env_int("BENCH_SERVE_BATCH", 1)
+    n_threads = _env_int("BENCH_SERVE_THREADS", 8)
+    window = _env_int("BENCH_SERVE_WINDOW", 128)
+    replicas = _env_int("BENCH_SERVE_REPLICAS", 2)
+    fleet_models = _env_int("BENCH_SERVE_FLEET_MODELS", 6)
+    fleet_capacity = _env_int("BENCH_SERVE_FLEET_CAPACITY", 4)
+    fleet_requests = _env_int("BENCH_SERVE_FLEET_REQUESTS", 60)
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(train_rows, n_feat).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    t0 = time.perf_counter()
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": n_leaves, "verbosity": -1},
+        ds, num_boost_round=n_trees,
+    )
+    train_s = time.perf_counter() - t0
+    probe = rs.randn(64, n_feat).astype(np.float32)
+    warm = rs.randn(batch, n_feat).astype(np.float32)
+
+    # ---- phase 1: single replica, direct path, one closed-loop client
+    # (raw margins on both sides: the comparison measures serving, not
+    # the objective's output transform)
+    baseline_reg = ModelRegistry(warmup=True)
+    baseline_reg.load("bench", bst, num_features=n_feat)
+    for _ in range(3):  # compiles + first-dispatch costs off the clock
+        baseline_reg.predict("bench", warm, raw_score=True)
+        baseline_reg.predict("bench", probe, raw_score=True)
+    baseline = _fire(
+        lambda rows: baseline_reg.predict("bench", rows, raw_score=True),
+        base_requests, 1, batch, n_feat,
+    )
+    baseline["threads"] = 1
+    baseline_pred = np.asarray(baseline_reg.predict("bench", probe))
+
+    # ---- phase 2: N replicas + continuous batching, pipelined clients
+    loaded_reg = ModelRegistry(warmup=True, replicas=replicas)
+    loaded_reg.load("bench", bst, num_features=n_feat)
+    batcher = loaded_reg.batcher("bench")
+    for _ in range(3):
+        batcher.submit(warm).result()
+        loaded_reg.predict("bench", probe, via_queue=True)
+    before = _serve_counters()
+    loaded = _fire_pipelined(
+        batcher.submit, n_requests, n_threads, window, batch, n_feat,
+    )
+    loaded["threads"] = n_threads
+    dispatcher = _dispatcher_view(
+        before, _serve_counters(), loaded["requests"] * batch)
+    # the speedup must answer the SAME question: probe scored through
+    # the coalescing multi-replica path must match the direct baseline
+    # bit for bit
+    loaded_pred = np.asarray(
+        loaded_reg.predict("bench", probe, via_queue=True))
+    bit_identical = bool(np.array_equal(baseline_pred, loaded_pred))
+    speedup = (round(loaded["qps"] / baseline["qps"], 2)
+               if baseline["qps"] else 0.0)
+
+    # ---- phase 3: multi-tenant fleet with LRU paging churn
+    fleet = ModelFleet(capacity=fleet_capacity)
+    names = [f"bench{i:02d}" for i in range(fleet_models)]
+    for name in names:
+        fleet.load(name, bst, num_features=n_feat)
+    per_model: dict = {name: [] for name in names}
+    t0 = time.perf_counter()
+    for i in range(fleet_requests):
+        name = names[i % len(names)]
+        rows = rs.randn(batch, n_feat).astype(np.float32)
+        t = time.perf_counter()
+        fleet.predict(name, rows)
+        per_model[name].append(time.perf_counter() - t)
+    fleet_wall = time.perf_counter() - t0
+    fstats = fleet.fleet_stats()
+    fleet_result = {
+        "fleet_size": fleet_models,
+        "capacity": fleet_capacity,
+        "resident": fstats.get("resident"),
+        "pages_in": fstats.get("pages_in"),
+        "evictions": fstats.get("evictions"),
+        "qps": round(fleet_requests / fleet_wall, 2) if fleet_wall else 0.0,
+        "per_model_p99_ms": {
+            name: round(1e3 * _pct(sorted(v), 0.99), 4)
+            for name, v in per_model.items()
+        },
+    }
+    fleet.close()
+
     result = {
         "schema": SCHEMA,
         "metric": "serve_score_qps",
-        "qps": round(done / wall, 2) if wall > 0 else 0.0,
-        "p50_ms": round(1e3 * _pct(lat, 0.50), 4),
-        "p95_ms": round(1e3 * _pct(lat, 0.95), 4),
-        "p99_ms": round(1e3 * _pct(lat, 0.99), 4),
-        "mean_ms": round(1e3 * sum(lat) / len(lat), 4) if lat else 0.0,
-        "rows_per_sec": round(done * batch / wall, 1) if wall > 0 else 0.0,
-        "requests": done,
+        **loaded,  # headline qps/p50/p99 = the replicated, batched path
         "batch_rows": batch,
-        "threads": n_threads,
-        "via_queue": use_queue,
-        "wall_s": round(wall, 3),
+        "via_queue": True,
+        "window": window,
+        "replicas": replicas,
+        "baseline": baseline,
+        "speedup_x": speedup,
+        "bit_identical": bit_identical,
+        "dispatcher": dispatcher,
+        "fleet_size": fleet_models,
+        "models": names,
+        "fleet": fleet_result,
         "model": {"trees": n_trees, "leaves": n_leaves,
                   "features": n_feat, "train_rows": train_rows,
                   "train_s": round(train_s, 2)},
@@ -142,7 +324,7 @@ def run_bench() -> dict:
         "device_count": jax.device_count(),
         # the observability view of the same run (LatencyStats ring —
         # what /metrics and the stats op report)
-        "stats": registry.stats().get("bench", {}),
+        "stats": loaded_reg.stats().get("bench", {}),
         "created_unix": time.time(),
         "run_id": f"{int(time.time())}-{os.getpid()}",
     }
@@ -167,16 +349,34 @@ def _next_out_path() -> str:
     return os.path.join(out_dir, f"BENCH_SERVE_r{max(rounds) + 1:02d}.json")
 
 
+def _manifest_path(out: str) -> str:
+    """Run manifests live under the tmp run dir (BENCH_RUN_DIR — the
+    same dir bench.py uses), never the repo root: the repo root once
+    grew a stale checked-in manifest. The path is stamped into the
+    artifact so the trajectory point still traces back to what ran;
+    BENCH_MANIFEST_OUT overrides for archival."""
+    if os.environ.get("BENCH_MANIFEST_OUT"):
+        return os.environ["BENCH_MANIFEST_OUT"]
+    run_dir = os.environ.get("BENCH_RUN_DIR") or os.path.join(
+        tempfile.gettempdir(), "lightgbm_tpu_bench"
+    )
+    try:
+        os.makedirs(run_dir, exist_ok=True)
+    except OSError:
+        run_dir = tempfile.gettempdir()
+    m = re.search(r"BENCH_SERVE_r(\d+)\.json$", out)
+    name = (f"run_manifest_serve_r{m.group(1)}.json" if m
+            else "run_manifest_serve.json")
+    return os.path.join(run_dir, name)
+
+
 def main() -> int:
     result = run_bench()
     out = _next_out_path()
     # provenance link: a run manifest (config + device topology +
-    # metrics snapshot) next to the artifact, path stamped into the
-    # json so the trajectory point traces back to what ran
-    mpath = re.sub(r"BENCH_SERVE_r(\d+)\.json$",
-                   r"run_manifest_serve_r\1.json", out)
-    if mpath == out:
-        mpath = out + ".manifest.json"
+    # metrics snapshot) under the run dir, path stamped into the json
+    # so the trajectory point traces back to what ran
+    mpath = _manifest_path(out)
     try:
         from lightgbm_tpu.obs.manifest import write_manifest
 

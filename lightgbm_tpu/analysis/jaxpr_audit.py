@@ -408,6 +408,76 @@ def _trace_serving_forest():
     )(tables, mk((N, F), jnp.float32), mk((T,), jnp.float32))
 
 
+def _forest_table_shapes(T, M, L, W, Ck, K):
+    import jax
+    import jax.numpy as jnp
+
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+    return {
+        "pack": mk((9, T * M), jnp.float32),
+        "catw": mk((W,), jnp.int32),
+        "leaf_value": mk((T, L), jnp.float32),
+        "leaf_const": mk((T, L), jnp.float32),
+        "leaf_nf": mk((T, L), jnp.int32),
+        "leaf_feat": mk((T, L, Ck), jnp.int32),
+        "leaf_coeff": mk((T, L, Ck), jnp.float32),
+        "init_node": mk((T,), jnp.int32),
+        "class_onehot": mk((T, K), jnp.float32),
+    }
+
+
+def _trace_serving_stack():
+    """Abstract trace of the fleet's stacked predictor
+    (serving/forest.py stacked_forest_apply): 4 resident slots of the
+    serving_forest family, the slot a traced scalar — the executable
+    every tenant of a shape family shares."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.forest import stacked_forest_apply
+
+    S, T, M, L, W, Ck, K, N, F = 4, 8, 31, 32, 4, 1, 1, 256, 16
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+    tables = _forest_table_shapes(T, M, L, W, Ck, K)
+    stack = {
+        k: jax.ShapeDtypeStruct((S,) + v.shape, v.dtype)
+        for k, v in tables.items()
+    }
+    return jax.make_jaxpr(
+        lambda st, s, X, w: stacked_forest_apply(
+            st, s, X, w, has_cat=True, linear=False
+        )
+    )(stack, mk((), jnp.int32), mk((N, F), jnp.float32),
+      mk((T,), jnp.float32))
+
+
+def _trace_serving_contrib():
+    """Abstract trace of the device TreeSHAP entry (serving/forest.py
+    contrib_apply): 8 trees x 15 nodes, path dims quantized to 8 edges
+    / 4 unique features, 64 rows x 16 features."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.forest import contrib_apply
+
+    T, M, L, W, Ck, K, N, F = 8, 15, 16, 4, 1, 1, 64, 16
+    E, P = 8, 4
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+    tables = _forest_table_shapes(T, M, L, W, Ck, K)
+    ctables = {
+        "nodes": mk((T, L, E), jnp.int32),
+        "dirs": mk((T, L, E), jnp.float32),
+        "slot_oh": mk((T, L, E, P), jnp.float32),
+        "zero": mk((T, L, P), jnp.float32),
+        "feat": mk((T, L, P), jnp.int32),
+        "expect": mk((T,), jnp.float32),
+        "tree_class": mk((T,), jnp.int32),
+    }
+    return jax.make_jaxpr(
+        lambda t, c, X, w: contrib_apply(t, c, X, w, has_cat=True)
+    )(tables, ctables, mk((N, F), jnp.float32), mk((T,), jnp.float32))
+
+
 class _Entry(NamedTuple):
     builder: Callable[[], Any]
     contracts: Callable[[Optional[int]], List[ContractFn]]
@@ -535,6 +605,31 @@ ENTRIES: Dict[str, _Entry] = {
         "serving predictor (serving/forest.py): f32/int32 scoring "
         "jaxpr, no callbacks, bounded size",
     ),
+    "serving_fleet_stack": _Entry(
+        _trace_serving_stack,
+        lambda budget: [
+            no_host_callbacks(),
+            no_f64(),
+            has_prim("while", "depth-stepped lockstep traversal"),
+            within_budget(budget),
+        ],
+        "fleet stacked predictor (serving/forest.py "
+        "stacked_forest_apply): slot-indexed scoring over (S, ...) "
+        "stacked tables, the executable a shape family shares",
+    ),
+    "serving_contrib": _Entry(
+        _trace_serving_contrib,
+        lambda budget: [
+            no_host_callbacks(),
+            no_f64(),
+            has_prim("scatter-add",
+                     "per-leaf deltas land on feature columns"),
+            within_budget(budget),
+        ],
+        "device TreeSHAP (serving/forest.py contrib_apply): "
+        "extend/unwind permutation-weight DP over (row, tree, leaf) "
+        "lanes, host shap.py parity",
+    ),
 }
 
 
@@ -626,6 +721,7 @@ def audit_faultinject() -> AuditResult:
         "engine.py",                  # per-round host loop
         "serving/dispatch.py",        # host side of the device call
         "serving/server.py",          # request transport
+        "serving/fleet.py",           # HBM paging (fleet_page site)
     }
     sites: List[str] = []
     offenders: List[str] = []

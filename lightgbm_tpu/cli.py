@@ -325,11 +325,28 @@ def _task_serve(params: Dict[str, str]) -> None:
         from .resilience import faultinject
 
         faultinject.configure(cfg.fault_plan)
-        registry = ModelRegistry(
-            mesh=mesh, buckets=cfg.serve_buckets, warmup=cfg.serve_warmup,
-            deadline_s=cfg.serve_deadline_ms / 1000.0,
-            queue_cap=cfg.serve_queue_cap,
-        )
+        if cfg.serve_fleet:
+            # multi-tenant fleet: capacity-bounded HBM residency with
+            # LRU paging instead of a table set per model
+            # (serving/fleet.py, docs/SERVING.md "Fleet serving")
+            from .serving import ModelFleet
+
+            registry = ModelFleet(
+                mesh=mesh, buckets=cfg.serve_buckets,
+                warmup=cfg.serve_warmup,
+                deadline_s=cfg.serve_deadline_ms / 1000.0,
+                queue_cap=cfg.serve_queue_cap,
+                capacity=cfg.serve_fleet_capacity,
+                slots_per_family=cfg.serve_fleet_slots,
+            )
+        else:
+            registry = ModelRegistry(
+                mesh=mesh, buckets=cfg.serve_buckets,
+                warmup=cfg.serve_warmup,
+                deadline_s=cfg.serve_deadline_ms / 1000.0,
+                queue_cap=cfg.serve_queue_cap,
+                replicas=cfg.serve_replicas,
+            )
         registry.load(cfg.serve_model_name, model_path)
         if cfg.serve_port > 0:
             serve_http(registry, cfg.serve_port, cfg.serve_host)

@@ -236,6 +236,16 @@ _PARAMS: Dict[str, _P] = {
     # with QueueOverflow -> HTTP 503 + Retry-After)
     "serve_deadline_ms": (0.0, float, (), _nonneg),
     "serve_queue_cap": (0, int, (), _nonneg),
+    # N predictor replicas per loaded model (round-robined over the
+    # local devices; the MicroBatcher drains through all of them —
+    # continuous batching). Ignored under a multi-device mesh.
+    "serve_replicas": (1, int, (), _pos),
+    # multi-tenant fleet serving (serving/fleet.py): models resident
+    # as stacked forest tables with LRU HBM paging; capacity = max
+    # models resident at once, slots = stack depth per shape family
+    "serve_fleet": (False, bool, (), None),
+    "serve_fleet_capacity": (32, int, (), _pos),
+    "serve_fleet_slots": (8, int, (), _pos),
     # ---- observability (lightgbm_tpu/obs, docs/OBSERVABILITY.md) ----
     # runtime switch for the phase timer (the env LIGHTGBM_TPU_TIMETAG
     # analog of the reference's compile-time USE_TIMETAG) — no restart

@@ -379,10 +379,13 @@ _latency_bridged: Dict[str, Any] = {}
 _latency_lock = threading.Lock()
 
 
-def register_latency_collector(name: str, stats: Any) -> None:
+def register_latency_collector(name: str, stats: Any,
+                               model: Optional[str] = None) -> None:
     """Expose a timer.LatencyStats on /metrics. Samples derive from the
     same ``snapshot()`` the serving stats op reports — one ring, every
-    reader (the dedupe contract for serving latency)."""
+    reader (the dedupe contract for serving latency). ``model`` adds a
+    ``{model=...}`` label for fleet tenants (one series set per model;
+    see docs/OBSERVABILITY.md for the cardinality contract)."""
     with _latency_lock:
         if name in _latency_bridged:
             return
@@ -391,6 +394,8 @@ def register_latency_collector(name: str, stats: Any) -> None:
     def collect() -> List[Sample]:
         snap = stats.snapshot()
         lab = (("entry", name),)
+        if model is not None:
+            lab = lab + (("model", model),)
         out = [
             Sample("lgbmtpu_serve_requests_total", "counter",
                    "requests observed by the latency ring", lab,
@@ -520,6 +525,28 @@ def record_registry_event(event: str, model: str) -> None:
     r.counter("lgbmtpu_registry_events_total",
               "model registry lifecycle events",
               labels=("event", "model")).inc(1, event=event, model=model)
+
+
+def record_fleet_page(model: str, event: str) -> None:
+    """Fleet HBM paging: ``page_in`` / ``evict`` / ``warmup`` for one
+    tenant (serving/fleet.py LRU residency)."""
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_fleet_page_events_total",
+              "fleet HBM paging events, by model and kind",
+              labels=("model", "event")).inc(1, model=model, event=event)
+
+
+def record_fleet_resident(resident: int, capacity: int) -> None:
+    """Current fleet residency vs the configured HBM capacity."""
+    r = _default
+    if not r.enabled:
+        return
+    r.gauge("lgbmtpu_fleet_resident_models",
+            "models currently resident in device memory").set(resident)
+    r.gauge("lgbmtpu_fleet_capacity_models",
+            "configured fleet residency capacity").set(capacity)
 
 
 def record_request_op(op: str, ok: bool) -> None:

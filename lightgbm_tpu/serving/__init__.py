@@ -15,13 +15,18 @@ this package is the TPU analog. Three pieces:
   with warm-up precompilation and a thread-safe microbatch queue;
 - ``registry``: a **model registry** — load / hot-swap / version
   multiple Boosters (text or JSON model format) behind one scoring
-  entry point, plus the ``ScoringServer`` loop ``cli.py`` exposes as
-  ``task=serve``.
+  entry point (optionally N predictor replicas per version), plus the
+  ``ScoringServer`` loop ``cli.py`` exposes as ``task=serve``;
+- ``fleet``: a **multi-tenant model fleet** — hundreds of registry
+  models resident as stacked forest tables with LRU HBM paging,
+  per-model QoS and metrics, and on-device TreeSHAP
+  (``pred_contrib``) over the packed tables.
 
 See docs/SERVING.md for the architecture.
 """
 
 from .dispatch import DEFAULT_BUCKETS, BucketDispatcher, MicroBatcher
+from .fleet import ModelFleet
 from .forest import TensorForest
 from .registry import ModelRegistry
 from .server import ScoringServer, serve_http
@@ -32,6 +37,7 @@ __all__ = [
     "MicroBatcher",
     "DEFAULT_BUCKETS",
     "ModelRegistry",
+    "ModelFleet",
     "ScoringServer",
     "serve_http",
 ]

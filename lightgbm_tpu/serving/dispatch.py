@@ -52,7 +52,7 @@ class BucketDispatcher:
     """Pads requests to a fixed shape ladder and scores on device."""
 
     def __init__(self, forest, buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 name: str = "serve"):
+                 name: str = "serve", model: Optional[str] = None):
         if not buckets:
             raise ValueError("need at least one bucket size")
         n_dev = max(int(getattr(forest, "num_devices", 1)), 1)
@@ -69,7 +69,9 @@ class BucketDispatcher:
         self.buckets: Tuple[int, ...] = tuple(aligned)
         self.forest = forest
         self.name = name
-        self._stats = latency_stats(name)
+        # model tags this entry's /metrics series with {model=...}
+        # (fleet tenants set it; docs/OBSERVABILITY.md cardinality note)
+        self._stats = latency_stats(name, model=model)
         # degradation path (docs/RESILIENCE.md): when a device scoring
         # call faults, a chunk can be rescored by the host tree-walker
         # instead of failing the request. The registry installs this as
@@ -198,16 +200,67 @@ class BucketDispatcher:
         self._stats.observe(time.perf_counter() - t0, X.shape[0])
         return out.astype(np.int64)
 
+    def predict_contrib(self, X: np.ndarray, start_iteration: int = 0,
+                        num_iteration: int = -1) -> np.ndarray:
+        """(N, K*(F+1)) SHAP contributions (Booster pred_contrib
+        layout) through the ladder. Contrib intermediates scale with
+        rows x trees x leaves x path length, so the contrib ladder is
+        capped at ``CONTRIB_MAX_ROWS`` — large requests chunk through
+        the capped top rung. No host fallback: a device fault fails
+        the explanation request (scoring traffic is the degradation-
+        protected path; explanations re-raise)."""
+        import jax.numpy as jnp
+
+        X, tw, start, end = self._prep(X, start_iteration, num_iteration)
+        F = X.shape[1]
+        K = self.forest.num_class
+        if X.shape[0] == 0:
+            return np.zeros((0, K * (F + 1)), np.float64)
+        t0 = time.perf_counter()
+        top = min(self.buckets[-1], CONTRIB_MAX_ROWS)
+        rungs = [b for b in self.buckets if b <= top] or [top]
+        outs = []
+        N, pos = X.shape[0], 0
+        while pos < N:
+            chunk = X[pos: pos + top]
+            rows = chunk.shape[0]
+            b = next((r for r in rungs if rows <= r), rungs[-1])
+            record_bucket_dispatch(f"{self.name}:contrib", b, rows)
+            if rows < b:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((b - rows, F), np.float32)]
+                )
+            out = self.forest.apply_contrib(jnp.asarray(chunk), tw)
+            outs.append(np.asarray(out)[:rows])
+            pos += top
+        out = np.concatenate(outs).astype(np.float64)
+        if self.forest.average_output and end > start:
+            out /= end - start
+        self._stats.observe(time.perf_counter() - t0, N)
+        return out
+
     def stats(self) -> dict:
         return self._stats.snapshot()
 
 
+# cap on rows per device TreeSHAP call: contrib intermediates are
+# (rows, trees, leaves, path) tensors, ~leaves x path larger per row
+# than scoring — the top scoring rung would not fit comfortably
+CONTRIB_MAX_ROWS = 256
+
+
 class MicroBatcher:
-    """Thread-safe request queue in front of a BucketDispatcher.
+    """Thread-safe request queue in front of one or more
+    BucketDispatchers.
 
     submit(rows) -> Future resolving to that request's (n, K) scores.
-    One worker thread drains the queue: everything pending (up to the
-    largest bucket) coalesces into a single padded device call.
+    One worker thread PER DISPATCHER drains a shared queue: everything
+    pending (up to the largest bucket) coalesces into a single padded
+    device call. With replica dispatchers this is the continuous-
+    batching front: while replica 0's batch is in flight on its
+    device, replica 1's worker is already coalescing and admitting the
+    next batch — requests never wait for a previous batch to land
+    (docs/SERVING.md "Fleet serving").
 
     Overload handling (docs/RESILIENCE.md "Serving degradation"):
 
@@ -226,11 +279,19 @@ class MicroBatcher:
       blocked forever on ``Future.result()``.
     """
 
-    def __init__(self, dispatcher: BucketDispatcher,
-                 max_delay_s: float = 0.002,
+    def __init__(self, dispatcher, max_delay_s: float = 0.002,
                  deadline_s: float = 0.0,
                  queue_cap: int = 0):
-        self.dispatcher = dispatcher
+        # a single dispatcher (anything duck-typing BucketDispatcher)
+        # or a list/tuple of replicas sharing identical model + ladder
+        # (the registry builds the replica list)
+        if isinstance(dispatcher, (list, tuple)):
+            self.dispatchers: Tuple[BucketDispatcher, ...] = tuple(dispatcher)
+        else:
+            self.dispatchers = (dispatcher,)
+        if not self.dispatchers:
+            raise ValueError("MicroBatcher needs at least one dispatcher")
+        self.dispatcher = self.dispatchers[0]  # primary (stats, width)
         self.max_delay_s = float(max_delay_s)
         self.deadline_s = float(deadline_s)  # 0 = no default deadline
         self.queue_cap = int(queue_cap)      # rows; 0 = unbounded
@@ -240,10 +301,15 @@ class MicroBatcher:
         self._pending_rows = 0
         self._cond = threading.Condition()
         self._closed = False
-        self._worker = threading.Thread(
-            target=self._run, name="lgb-serve-microbatch", daemon=True
-        )
-        self._worker.start()
+        self._workers = [
+            threading.Thread(
+                target=self._run, args=(d,),
+                name=f"lgb-serve-microbatch-{i}", daemon=True,
+            )
+            for i, d in enumerate(self.dispatchers)
+        ]
+        for w in self._workers:
+            w.start()
 
     def submit(self, X: np.ndarray,
                deadline_s: Optional[float] = None) -> Future:
@@ -298,8 +364,9 @@ class MicroBatcher:
         fail, not hang their callers forever."""
         with self._cond:
             self._closed = True
-            self._cond.notify()
-        self._worker.join(timeout=5)
+            self._cond.notify_all()
+        for w in self._workers:
+            w.join(timeout=5)
         with self._cond:
             leftovers = self._pending
             self._pending = []
@@ -328,8 +395,8 @@ class MicroBatcher:
             )
         return expired
 
-    def _run(self) -> None:
-        top = self.dispatcher.buckets[-1]
+    def _run(self, dispatcher: BucketDispatcher) -> None:
+        top = dispatcher.buckets[-1]
         while True:
             expired: List[Tuple[np.ndarray, Future, Optional[float]]] = []
             batch: List[Tuple[np.ndarray, Future]] = []
@@ -362,19 +429,19 @@ class MicroBatcher:
                         rows += X.shape[0]
                 depth = len(self._pending)
             for _, fut, _ in expired:
-                record_serve_rejection(self.dispatcher.name, "deadline")
+                record_serve_rejection(dispatcher.name, "deadline")
                 if not fut.done():
                     fut.set_exception(DeadlineExceeded(
                         "request expired in the microbatch queue"
                     ))
             if not batch:
                 continue
-            record_queue_depth(self.dispatcher.name, depth)
-            record_coalesce(self.dispatcher.name, len(batch), rows)
+            record_queue_depth(dispatcher.name, depth)
+            record_coalesce(dispatcher.name, len(batch), rows)
             try:
                 Xall = np.concatenate([x for x, _ in batch]) \
                     if len(batch) > 1 else batch[0][0]
-                out = self.dispatcher.score_raw(Xall)  # (K, N)
+                out = dispatcher.score_raw(Xall)  # (K, N)
                 pos = 0
                 for X, fut in batch:
                     n = X.shape[0]

@@ -1,0 +1,687 @@
+"""Multi-tenant model fleet: hundreds of models behind one process,
+resident as stacked forest tables with LRU HBM paging.
+
+The registry (registry.py) keeps one TensorForest — one set of device
+tables and one executable family — per loaded version: exactly right
+for a handful of models, hopeless for a fleet of hundreds (HBM fills,
+and every distinct table shape compiles its own ladder). The fleet
+changes the residency unit:
+
+- models group into SHAPE FAMILIES by their power-of-two-quantized
+  table dims; each family owns one or more ``(S, ...)``-stacked device
+  table sets (:class:`ForestStack`). Scoring slot ``s`` goes through
+  ``stacked_forest_apply`` with the slot as a TRACED index, so the
+  whole family shares one executable per bucket — paging never
+  recompiles.
+- an LRU pager moves models between host tables (always held, numpy)
+  and a stack slot (HBM). Page-in writes the slot via one jitted
+  functional update and warms the smallest bucket; eviction just
+  releases the slot. A PIN COUNT per model keeps every model of an
+  in-flight request resident until its last chunk lands — a request
+  can never observe a torn slot or another tenant's trees.
+- per-model QoS: each tenant carries its own queue deadline and
+  admission cap (falling back to the fleet defaults), applied to its
+  lazily-built MicroBatcher; per-model ``lgbmtpu_*{model=...}`` series
+  land on /metrics through the dispatcher's latency ring.
+- hot-swap/rollback keep registry semantics: versions are independent
+  residency entries and the active pointer moves atomically under the
+  fleet lock; in-flight requests pinned to the old version finish on
+  the old slot.
+- ``pred_contrib`` serves device TreeSHAP (forest.py contrib_apply)
+  from per-model contrib tables packed lazily on first request and
+  dropped on eviction — explanation traffic pays for its own HBM.
+
+Locking: ONE condition variable guards all fleet state (names,
+versions, stacks, pins, residency counts) — there is no second fleet
+lock to order against. Device work (table uploads, stack writes,
+warm-up, scoring) always happens OUTSIDE the condition; readers take
+a stack/slot snapshot under it and score on the snapshot, which stays
+valid because stack writes are functional updates and pinned slots
+are never reassigned.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import log
+from ..obs.metrics import (
+    record_fleet_page,
+    record_fleet_resident,
+    record_registry_event,
+    record_serve_rejection,
+)
+from ..resilience.errors import QueueOverflow
+from ..resilience.faultinject import fault_point
+from .dispatch import DEFAULT_BUCKETS, BucketDispatcher
+from .forest import (
+    _pow2,
+    _stacked_apply_jit,
+    pack_contrib_tables,
+    pack_forest_tables,
+    pad_forest_tables,
+)
+from .registry import _booster_from, _make_host_fallback
+
+_STACK_WRITE_JIT = None
+
+
+def _stack_write_jit():
+    """Jitted functional slot write: one executable per stack shape.
+
+    NEVER donates the input stack: a concurrent reader scoring another
+    slot holds the previous arrays — donation would invalidate the
+    buffers under it (and XLA:CPU donation has crashed before; see
+    ROADMAP history). The transient 2x stack during a write is the
+    price of torn-free paging."""
+    global _STACK_WRITE_JIT
+    if _STACK_WRITE_JIT is None:
+        import jax
+
+        def write(arrays, slot, new):
+            return {k: arrays[k].at[slot].set(new[k]) for k in arrays}
+
+        _STACK_WRITE_JIT = jax.jit(write)
+    return _STACK_WRITE_JIT
+
+
+def _family_key(meta: Dict[str, Any],
+                tables: Dict[str, np.ndarray]) -> Tuple:
+    """Quantized shape-family key: models padding to the same key share
+    one stacked executable. Power-of-two quantization trades a bounded
+    amount of padding waste for far fewer families (= fewer compiles,
+    denser stacks)."""
+    d = max(int(meta["max_depth"]), 1)
+    return (
+        _pow2(meta["num_trees"]),
+        _pow2(meta["max_nodes"]),
+        _pow2(meta["max_leaves"]),
+        int(meta["num_class"]),
+        _pow2(tables["catw"].shape[0]),
+        _pow2(tables["leaf_feat"].shape[2]),
+        1 << (d - 1).bit_length(),
+        bool(meta["has_cat"]),
+        bool(meta["linear"]),
+    )
+
+
+class ForestStack:
+    """One family's stacked device tables: (S, ...) arrays plus a
+    slot -> entry occupancy map. All mutation happens under the owning
+    fleet's condition; the arrays themselves are replaced wholesale by
+    functional jit writes, so readers of a previous arrays dict are
+    never torn."""
+
+    def __init__(self, key: Tuple, slots: int):
+        self.key = key
+        self.slots = int(slots)
+        self.arrays: Optional[Dict[str, Any]] = None
+        self.occupant: List[Optional[Any]] = [None] * self.slots
+        # one page-in at a time per stack: the functional write reads
+        # self.arrays, so two concurrent writers would each start from
+        # the same snapshot and the later assignment would silently
+        # drop the earlier model. The fleet serializes writers on this
+        # flag under its condition (readers are unaffected).
+        self.writing = False
+
+    def ensure_arrays(self, template: Dict[str, np.ndarray]) -> None:
+        """Allocate the zeroed (S, ...) stack from a padded template's
+        shapes (first page-in of the family). Device allocation — call
+        OUTSIDE the fleet condition."""
+        import jax.numpy as jnp
+
+        if self.arrays is None:
+            self.arrays = {
+                k: jnp.zeros((self.slots,) + np.asarray(v).shape,
+                             jnp.asarray(v).dtype)
+                for k, v in template.items()
+            }
+
+    def write(self, slot: int, padded: Dict[str, np.ndarray]) -> None:
+        """Upload one model into its slot (device work; outside the
+        fleet condition). Functional: readers keep the old arrays."""
+        import jax.numpy as jnp
+
+        self.ensure_arrays(padded)
+        new = {k: jnp.asarray(v) for k, v in padded.items()}
+        self.arrays = _stack_write_jit()(
+            self.arrays, jnp.int32(slot), new
+        )
+
+
+class _SlotForest:
+    """TensorForest-protocol adapter over a fleet residency entry, so
+    BucketDispatcher (ladder, chunking, metrics, host fallback) works
+    unchanged for fleet tenants. ``apply`` snapshots (stack arrays,
+    slot) under the fleet condition and scores outside it; callers
+    hold a pin for the duration of the request, so the slot cannot be
+    reassigned mid-request."""
+
+    mesh = None
+    num_devices = 1
+
+    def __init__(self, fleet: "ModelFleet", entry: "_FleetEntry"):
+        self._fleet = fleet
+        self._entry = entry
+        meta = entry.meta
+        self.meta = meta
+        self.num_class = meta["num_class"]
+        self.num_trees = meta["num_trees"]  # TRUE tree count
+        self.average_output = bool(entry.average_output)
+        self.max_feature = meta["max_feature"]
+        # family-quantized while_loop bound (part of the family key)
+        self._depth_bound = entry.family[6]
+        self._stack_trees = entry.family[0]
+
+    @property
+    def jit_entry(self):
+        return _stacked_apply_jit()
+
+    def _tree_weights(self, start_iteration: int,
+                      num_iteration: int) -> Tuple[np.ndarray, int, int]:
+        K = self.num_class
+        n_iters = self.num_trees // K
+        end = n_iters if num_iteration <= 0 else min(
+            n_iters, start_iteration + num_iteration
+        )
+        # padded to the stack's tree count: padding trees have zeroed
+        # class-onehot rows, so any weight there scores 0 anyway
+        tw = np.zeros(self._stack_trees, np.float32)
+        tw[start_iteration * K: end * K] = 1.0
+        return tw, start_iteration, end
+
+    def _check_width(self, X: np.ndarray) -> None:
+        if X.shape[1] <= self.max_feature:
+            raise IndexError(
+                f"input has {X.shape[1]} features but the model "
+                f"references feature {self.max_feature}"
+            )
+
+    def apply(self, X, tw):
+        import jax.numpy as jnp
+
+        e = self._entry
+        with self._fleet._cond:
+            if e.state != "ready":
+                raise RuntimeError(
+                    f"fleet model {e.name!r} v{e.version} applied "
+                    "while not resident (missing pin)"
+                )
+            arrays, slot = e.stack.arrays, e.slot
+        fam = e.family
+        return _stacked_apply_jit()(
+            arrays, jnp.int32(slot), X, jnp.asarray(tw, jnp.float32),
+            has_cat=fam[7], linear=fam[8], max_depth=fam[6],
+        )
+
+    def apply_contrib(self, X, tw):
+        import jax.numpy as jnp
+
+        main, ct, _ = self._fleet._contrib_tables(self._entry)
+        from .forest import _contrib_apply_jit
+
+        # contrib runs on the entry's own (unpadded) tables: the tw
+        # the dispatcher built is stack-width, the true prefix is ours
+        T = self._entry.meta["num_trees"]
+        return _contrib_apply_jit()(
+            main, ct, X, jnp.asarray(tw[:T], jnp.float32),
+            has_cat=self._entry.family[7],
+        )
+
+
+@dataclass
+class _FleetEntry:
+    """One (name, version): host tables always, a stack slot when hot."""
+
+    name: str
+    version: int
+    booster: Any
+    host_tables: Dict[str, np.ndarray]  # unpadded numpy (the cold copy)
+    meta: Dict[str, Any]
+    source: str
+    family: Tuple
+    average_output: bool
+    deadline_s: float
+    queue_cap: int
+    loaded_at: float = field(default_factory=time.time)
+    state: str = "cold"  # cold | loading | ready
+    stack: Optional[ForestStack] = None
+    slot: int = -1
+    pins: int = 0
+    last_used: float = 0.0
+    retired: bool = False
+    forest: Any = None          # _SlotForest
+    dispatcher: Any = None      # BucketDispatcher
+    batcher: Any = None         # lazy MicroBatcher (via_queue)
+    ctables: Any = None         # lazy (main_dev, contrib_dev, cmeta)
+
+
+class ModelFleet:
+    """Registry-compatible multi-tenant model store (docs/SERVING.md
+    "Fleet serving"): same load / swap / rollback / unload / models /
+    stats / predict surface as ModelRegistry, so ScoringServer and the
+    HTTP transport work unchanged — but capacity-bounded HBM residency
+    instead of a device table set per model."""
+
+    def __init__(self, mesh=None, buckets=DEFAULT_BUCKETS,
+                 warmup: bool = False, deadline_s: float = 0.0,
+                 queue_cap: int = 0, host_fallback: bool = True,
+                 capacity: int = 32, slots_per_family: int = 8,
+                 page_timeout_s: float = 30.0):
+        if mesh is not None:
+            log.warning("fleet serving ignores the mesh: stacked "
+                        "tables live on one device per stack")
+        self.buckets = tuple(int(b) for b in buckets)
+        self.default_warmup = bool(warmup)
+        self.deadline_s = float(deadline_s)
+        self.queue_cap = int(queue_cap)
+        self.host_fallback = bool(host_fallback)
+        self.capacity = max(int(capacity), 1)
+        self.slots_per_family = max(int(slots_per_family), 1)
+        self.page_timeout_s = float(page_timeout_s)
+        self._cond = threading.Condition()
+        self._names: Dict[str, Dict[str, Any]] = {}
+        self._stacks: Dict[Tuple, List[ForestStack]] = {}
+        self._resident = 0
+        self._pages_in = 0
+        self._evictions = 0
+
+    # ---------------------------------------------------------- load
+    def load(self, name: str, source: Any, *, activate: bool = True,
+             warmup: Optional[bool] = None,
+             num_features: Optional[int] = None,
+             deadline_ms: Optional[float] = None,
+             queue_cap: Optional[int] = None) -> int:
+        """Register a model version: pack host tables (outside the
+        lock — loading must never stall scoring), record QoS, and
+        optionally page it in eagerly (``warmup``). ``deadline_ms`` /
+        ``queue_cap`` are the tenant's QoS overrides; omitted fields
+        inherit the fleet defaults."""
+        booster, src = _booster_from(source)
+        g = booster._gbdt
+        tables, meta = pack_forest_tables(list(g.models), g.num_class)
+        fam = _family_key(meta, tables)
+        entry = _FleetEntry(
+            name=name, version=0, booster=booster,
+            host_tables=tables, meta=meta, source=src, family=fam,
+            average_output=bool(getattr(g, "average_output", False)),
+            deadline_s=(self.deadline_s if deadline_ms is None
+                        else float(deadline_ms) / 1000.0),
+            queue_cap=(self.queue_cap if queue_cap is None
+                       else int(queue_cap)),
+        )
+        with self._cond:
+            rec = self._names.setdefault(
+                name, {"versions": [], "active": 0}
+            )
+            v = (rec["versions"][-1].version + 1) if rec["versions"] \
+                else 1
+            entry.version = v
+            rec["versions"].append(entry)
+            if activate or rec["active"] == 0:
+                rec["active"] = v
+        entry.forest = _SlotForest(self, entry)
+        entry.dispatcher = BucketDispatcher(
+            entry.forest, self.buckets,
+            name=f"fleet:{name}" if v == 1 else f"fleet:{name}:v{v}",
+            model=name,
+        )
+        if self.host_fallback:
+            entry.dispatcher.host_fallback = _make_host_fallback(
+                booster, entry.forest
+            )
+        record_registry_event("load", name)
+        do_warm = self.default_warmup if warmup is None else warmup
+        if do_warm:
+            self._acquire(entry)
+            self._release(entry)
+        log.info(f"fleet: loaded {name!r} v{v} from {src} "
+                 f"(family {fam})")
+        return v
+
+    # ------------------------------------------------------ residency
+    def _find_slot_locked(
+        self, family: Tuple
+    ) -> Optional[Tuple[ForestStack, int]]:
+        """A free slot in the family's stacks, growing a new stack if
+        the family has none free (global capacity still applies —
+        callers check ``_resident`` first)."""
+        stacks = self._stacks.setdefault(family, [])
+        for st in stacks:
+            for s, occ in enumerate(st.occupant):
+                if occ is None:
+                    return st, s
+        st = ForestStack(family, self.slots_per_family)
+        stacks.append(st)
+        return st, 0
+
+    def _evict_locked(self, entry: "_FleetEntry", event: str) -> None:
+        entry.state = "cold"
+        if entry.stack is not None and entry.slot >= 0:
+            entry.stack.occupant[entry.slot] = None
+        entry.stack, entry.slot = None, -1
+        entry.ctables = None  # contrib HBM goes with the slot
+        # callers hold self._cond (the _locked suffix contract; the
+        # per-function lint cannot see the call sites)
+        self._resident -= 1  # lint: allow[unlocked-write]
+        self._evictions += 1  # lint: allow[unlocked-write]
+        record_fleet_page(entry.name, event)
+
+    def _evict_lru_locked(self) -> bool:
+        """Evict the least-recently-used unpinned ready entry; False
+        when every resident entry is pinned (caller waits)."""
+        victim: Optional[_FleetEntry] = None
+        for rec in self._names.values():
+            for e in rec["versions"]:
+                if e.state == "ready" and e.pins == 0:
+                    if victim is None or e.last_used < victim.last_used:
+                        victim = e
+        if victim is None:
+            return False
+        self._evict_locked(victim, "evict")
+        return True
+
+    def _acquire(self, entry: "_FleetEntry") -> None:
+        """Pin ``entry`` resident, paging it in if cold. Blocks while
+        another thread is paging it; raises QueueOverflow when the
+        fleet's residency is exhausted by pinned models for longer
+        than ``page_timeout_s`` (the HTTP transport maps that to 503 —
+        overload, not failure)."""
+        deadline = time.monotonic() + self.page_timeout_s
+        with self._cond:
+            while True:
+                if entry.retired:
+                    raise KeyError(
+                        f"model {entry.name!r} v{entry.version} was "
+                        "unloaded"
+                    )
+                if entry.state == "ready":
+                    entry.pins += 1
+                    entry.last_used = time.monotonic()
+                    return
+                if entry.state == "loading":
+                    self._wait_or_reject_locked(entry, deadline)
+                    continue
+                # cold: make room, claim a slot, and page in
+                if self._resident >= self.capacity:
+                    if not self._evict_lru_locked():
+                        self._wait_or_reject_locked(entry, deadline)
+                        continue
+                st, slot = self._find_slot_locked(entry.family)
+                if st.writing:
+                    # another tenant is paging into this stack — the
+                    # functional write must not race it
+                    self._wait_or_reject_locked(entry, deadline)
+                    continue
+                st.writing = True
+                st.occupant[slot] = entry
+                entry.stack, entry.slot = st, slot
+                entry.state = "loading"
+                self._resident += 1
+                break
+        # ---- device work outside the condition ----
+        try:
+            fault_point("fleet_page")
+            padded, _ = pad_forest_tables(
+                entry.host_tables, entry.meta,
+                num_trees=entry.family[0], max_nodes=entry.family[1],
+                max_leaves=entry.family[2], cat_words=entry.family[4],
+                lin_feats=entry.family[5],
+            )
+            entry.stack.write(entry.slot, padded)
+            self._warm_slot(entry)
+        except Exception:
+            with self._cond:
+                entry.stack.writing = False
+                self._evict_locked(entry, "page_fail")
+                self._cond.notify_all()
+            raise
+        with self._cond:
+            entry.stack.writing = False
+            entry.state = "ready"
+            entry.pins += 1
+            entry.last_used = time.monotonic()
+            resident = self._resident
+            self._pages_in += 1
+            self._cond.notify_all()
+        record_fleet_page(entry.name, "page_in")
+        record_fleet_resident(resident, self.capacity)
+
+    def _wait_or_reject_locked(self, entry: "_FleetEntry",
+                               deadline: float) -> None:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            record_serve_rejection(f"fleet:{entry.name}", "overloaded")
+            raise QueueOverflow(
+                "fleet residency exhausted: "
+                f"{self._resident}/{self.capacity} resident, all "
+                "pinned"
+            )
+        self._cond.wait(min(remaining, 0.1))
+
+    def _warm_slot(self, entry: "_FleetEntry") -> None:
+        """Smallest-bucket warm-up after a page-in: first page-in of a
+        family compiles the shared executable; later ones just touch
+        the slot so the first real request is pure scoring."""
+        import jax.numpy as jnp
+
+        F = max(entry.meta["max_feature"] + 1, 1)
+        tw = np.ones(entry.family[0], np.float32)
+        fam = entry.family
+        score, _ = _stacked_apply_jit()(
+            entry.stack.arrays, jnp.int32(entry.slot),
+            jnp.zeros((self.buckets[0], F), jnp.float32),
+            jnp.asarray(tw),
+            has_cat=fam[7], linear=fam[8], max_depth=fam[6],
+        )
+        score.block_until_ready()
+        record_fleet_page(entry.name, "warmup")
+
+    def _release(self, entry: "_FleetEntry") -> None:
+        with self._cond:
+            entry.pins -= 1
+            if entry.retired and entry.pins == 0 \
+                    and entry.state == "ready":
+                # unload arrived while this request was in flight
+                self._evict_locked(entry, "evict")
+            self._cond.notify_all()
+
+    def _contrib_tables(self, entry: "_FleetEntry"):
+        """Lazy device TreeSHAP tables for one tenant: the entry's own
+        unpadded main tables plus the packed contrib tables. Dropped
+        on eviction; a later explanation request re-packs."""
+        with self._cond:
+            if entry.ctables is not None:
+                return entry.ctables
+        import jax.numpy as jnp
+
+        g = entry.booster._gbdt
+        ct, cmeta = pack_contrib_tables(
+            list(g.models), entry.meta["num_class"]
+        )
+        main = {k: jnp.asarray(v) for k, v in entry.host_tables.items()}
+        ctd = {k: jnp.asarray(v) for k, v in ct.items()}
+        with self._cond:
+            # two racing packers both built valid tables; keep one
+            if entry.ctables is None:
+                entry.ctables = (main, ctd, cmeta)
+            return entry.ctables
+
+    # ------------------------------------------------------- registry
+    def _entry_locked(self, name: str,
+                      version: Optional[int] = None) -> "_FleetEntry":
+        if name not in self._names:
+            raise KeyError(f"unknown model {name!r}")
+        rec = self._names[name]
+        v = rec["active"] if version is None else int(version)
+        for e in rec["versions"]:
+            if e.version == v:
+                return e
+        raise KeyError(f"model {name!r} has no version {v}")
+
+    def swap(self, name: str, version: int) -> None:
+        with self._cond:
+            e = self._entry_locked(name, version)
+            self._names[name]["active"] = e.version
+        record_registry_event("swap", name)
+
+    def rollback(self, name: str) -> int:
+        with self._cond:
+            if name not in self._names:
+                raise KeyError(f"unknown model {name!r}")
+            rec = self._names[name]
+            cur = rec["active"]
+            older = [e.version for e in rec["versions"]
+                     if e.version < cur]
+            if not older:
+                raise KeyError(
+                    f"model {name!r} has no version below {cur}"
+                )
+            rec["active"] = max(older)
+            active = rec["active"]
+        record_registry_event("rollback", name)
+        return active
+
+    def unload(self, name: str,
+               version: Optional[int] = None) -> None:
+        dropped: List[_FleetEntry] = []
+        with self._cond:
+            if version is None:
+                rec = self._names.pop(name, None)
+                if rec:
+                    dropped = rec["versions"]
+            else:
+                rec = self._names.get(name)
+                if rec is None:
+                    return
+                if rec["active"] == int(version):
+                    raise ValueError(
+                        f"version {version} of {name!r} is active; "
+                        "swap first or unload the whole name"
+                    )
+                kept = []
+                for e in rec["versions"]:
+                    (kept if e.version != int(version)
+                     else dropped).append(e)
+                rec["versions"] = kept
+            for e in dropped:
+                e.retired = True
+                if e.state == "ready" and e.pins == 0:
+                    self._evict_locked(e, "evict")
+                # pinned entries evict in _release when the last
+                # in-flight request lands
+            self._cond.notify_all()
+        for e in dropped:  # outside the lock: close() joins workers
+            if e.batcher is not None:
+                e.batcher.close()
+        if dropped:
+            record_registry_event("unload", name)
+
+    def models(self) -> Dict[str, Dict[str, Any]]:
+        with self._cond:
+            return {
+                name: {
+                    "active": rec["active"],
+                    "versions": [
+                        {"version": e.version, "source": e.source,
+                         "num_trees": e.meta["num_trees"],
+                         "num_class": e.meta["num_class"],
+                         "loaded_at": e.loaded_at,
+                         "resident": e.state == "ready"}
+                        for e in rec["versions"]
+                    ],
+                }
+                for name, rec in self._names.items()
+            }
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                name: self._entry_locked(name).dispatcher.stats()
+                for name in self._names
+            }
+
+    def fleet_stats(self) -> Dict[str, Any]:
+        with self._cond:
+            families = {
+                str(k): sum(
+                    1 for st in v for o in st.occupant if o is not None
+                )
+                for k, v in self._stacks.items()
+            }
+            return {
+                "resident": self._resident,
+                "capacity": self.capacity,
+                "models": len(self._names),
+                "pages_in": self._pages_in,
+                "evictions": self._evictions,
+                "families": families,
+            }
+
+    def close(self) -> None:
+        """Fail-safe shutdown: close every tenant's batcher."""
+        with self._cond:
+            entries = [e for rec in self._names.values()
+                       for e in rec["versions"]]
+        for e in entries:
+            if e.batcher is not None:
+                e.batcher.close()
+
+    # -------------------------------------------------------- predict
+    def predict(self, name: str, X, *, raw_score: bool = False,
+                start_iteration: int = 0, num_iteration: int = -1,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                via_queue: bool = False,
+                version: Optional[int] = None,
+                deadline_s: Optional[float] = None) -> np.ndarray:
+        """ModelRegistry.predict semantics over the fleet: resolve the
+        active version, pin it resident for the whole request (paging
+        it in if cold), score through its dispatcher, release. The pin
+        spans submit AND result for queued requests, so every request
+        coalesced into a device call holds its model in place."""
+        with self._cond:
+            entry = self._entry_locked(name, version)
+        self._acquire(entry)
+        try:
+            if pred_leaf:
+                return entry.dispatcher.predict_leaf(
+                    X, start_iteration, num_iteration
+                )
+            if pred_contrib:
+                return entry.dispatcher.predict_contrib(
+                    X, start_iteration, num_iteration
+                )
+            batcher = None
+            if via_queue and start_iteration == 0 \
+                    and num_iteration == -1:
+                with self._cond:
+                    if not entry.retired:
+                        if entry.batcher is None:
+                            from .dispatch import MicroBatcher
+
+                            entry.batcher = MicroBatcher(
+                                entry.dispatcher,
+                                deadline_s=entry.deadline_s,
+                                queue_cap=entry.queue_cap,
+                            )
+                        batcher = entry.batcher
+            if batcher is not None:
+                raw = batcher.submit(
+                    X, deadline_s=deadline_s
+                ).result().T
+            else:
+                raw = entry.dispatcher.score_raw(
+                    X, start_iteration, num_iteration
+                )
+            g = entry.booster._gbdt
+            if not raw_score and g.objective is not None:
+                raw = g.objective.convert_output(raw)
+            K = entry.meta["num_class"]
+            return raw[0] if K == 1 else raw.T
+        finally:
+            self._release(entry)

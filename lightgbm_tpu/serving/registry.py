@@ -46,6 +46,9 @@ class ModelVersion:
     source: str
     loaded_at: float = field(default_factory=time.time)
     batcher: Any = None  # lazy MicroBatcher (predict via_queue=True)
+    # replica dispatchers (dispatcher is replicas[0]); direct predicts
+    # round-robin over these, via_queue drains through all of them
+    replicas: List[BucketDispatcher] = field(default_factory=list)
 
 
 def _booster_from(source: Any):
@@ -117,10 +120,19 @@ class ModelRegistry:
 
     def __init__(self, mesh=None, buckets=DEFAULT_BUCKETS,
                  warmup: bool = False, deadline_s: float = 0.0,
-                 queue_cap: int = 0, host_fallback: bool = True):
+                 queue_cap: int = 0, host_fallback: bool = True,
+                 replicas: int = 1):
         self.mesh = mesh
         self.buckets = tuple(int(b) for b in buckets)
         self.default_warmup = bool(warmup)
+        # N predictor replicas per loaded version (round-robined over
+        # the local devices); with a mesh the forest already spans the
+        # devices, so replication applies to non-mesh registries only
+        self.replicas = max(int(replicas), 1)
+        if mesh is not None and self.replicas > 1:
+            log.warning("registry replicas ignored under a mesh "
+                        "(the mesh already spans the devices)")
+            self.replicas = 1
         # resilience knobs (docs/RESILIENCE.md "Serving degradation"):
         # default queue deadline + admission cap for every lazily-built
         # MicroBatcher (serve_deadline_ms / serve_queue_cap params),
@@ -132,6 +144,7 @@ class ModelRegistry:
         self._lock = threading.RLock()
         self._models: Dict[str, List[ModelVersion]] = {}
         self._active: Dict[str, int] = {}
+        self._rr = 0  # round-robin cursor for direct replica predicts
 
     # ------------------------------------------------------------------
     def load(self, name: str, source: Any, *, activate: bool = True,
@@ -143,11 +156,29 @@ class ModelRegistry:
         must never stall scoring on already-active models."""
         booster, src = _booster_from(source)
         forest = TensorForest.from_booster(booster, mesh=self.mesh)
-        dispatcher = BucketDispatcher(
-            forest, self.buckets, name=f"serve:{name}"
-        )
+        forests = [forest]
+        if self.replicas > 1:
+            import jax
+
+            from .forest import replicate_forest
+
+            devs = jax.local_devices()
+            forests += [
+                replicate_forest(forest, devs[i % len(devs)])
+                for i in range(1, self.replicas)
+            ]
+        dispatchers = [
+            BucketDispatcher(
+                f, self.buckets,
+                name=f"serve:{name}" if i == 0 else f"serve:{name}:r{i}",
+            )
+            for i, f in enumerate(forests)
+        ]
+        dispatcher = dispatchers[0]
         if self.host_fallback:
-            dispatcher.host_fallback = _make_host_fallback(booster, forest)
+            fb = _make_host_fallback(booster, forest)
+            for d in dispatchers:
+                d.host_fallback = fb
         do_warm = self.default_warmup if warmup is None else warmup
         if do_warm:
             if num_features is None:
@@ -158,11 +189,15 @@ class ModelRegistry:
                     num_features = booster.num_feature() or None
                 except Exception:  # noqa: BLE001 — fall back to max_feature
                     num_features = None
-            dispatcher.warmup(num_features)
+            for d in dispatchers:  # each replica device compiles its own
+                d.warmup(num_features)
         with self._lock:
             versions = self._models.setdefault(name, [])
             v = (versions[-1].version + 1) if versions else 1
-            versions.append(ModelVersion(v, booster, forest, dispatcher, src))
+            versions.append(ModelVersion(
+                v, booster, forest, dispatcher, src,
+                replicas=dispatchers,
+            ))
             if activate or name not in self._active:
                 self._active[name] = v
         record_registry_event("load", name)
@@ -253,20 +288,55 @@ class ModelRegistry:
                 for name in self._models
             }
 
+    def _batcher_for(self, name: str, mv) -> Optional[Any]:
+        """The version's MicroBatcher, created lazily under the lock;
+        None when mv was unloaded concurrently (a fresh worker thread
+        nothing would ever close must not be resurrected)."""
+        with self._lock:
+            if not any(m is mv for m in self._models.get(name, [])):
+                return None
+            if mv.batcher is None:
+                from .dispatch import MicroBatcher
+
+                mv.batcher = MicroBatcher(
+                    mv.replicas or mv.dispatcher,
+                    deadline_s=self.deadline_s,
+                    queue_cap=self.queue_cap,
+                )
+            return mv.batcher
+
+    def batcher(self, name: str, version: Optional[int] = None):
+        """The model's continuous-batching front (the same MicroBatcher
+        ``predict(via_queue=True)`` coalesces through, shared across
+        callers and drained by one worker per replica). Async clients
+        ``submit(rows)`` and collect futures — each resolves to that
+        request's (n, K) RAW margins — so a pipelined client keeps the
+        queue fed without blocking per request (the pattern
+        bench_serve.py's loaded phase drives)."""
+        mv = self._entry(name, version)
+        b = self._batcher_for(name, mv)
+        if b is None:
+            raise KeyError(f"model {name!r} was unloaded")
+        return b
+
     # ------------------------------------------------------------------
     def predict(self, name: str, X, *, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
-                pred_leaf: bool = False, via_queue: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                via_queue: bool = False,
                 version: Optional[int] = None,
                 deadline_s: Optional[float] = None) -> np.ndarray:
         """One scoring entry point for every registered model; output
         layout matches Booster.predict ((N,) single-class, (N, K)
-        multiclass, (N, T) for pred_leaf).
+        multiclass, (N, T) for pred_leaf, (N, K*(F+1)) for
+        pred_contrib — device TreeSHAP, host shap.py parity).
 
         via_queue=True routes default-parameter scoring through the
         version's MicroBatcher, so concurrent callers (the threaded
         HTTP server's request threads, protocol "queue": true) coalesce
-        into shared padded device calls; truncated or pred_leaf
+        into shared padded device calls — with replicas, one queue
+        worker per replica keeps admitting while other replicas'
+        batches are in flight; truncated, pred_leaf, and pred_contrib
         requests always dispatch directly (a coalesced batch must share
         one parameter set)."""
         mv = self._entry(name, version)
@@ -274,31 +344,27 @@ class ModelRegistry:
             return mv.dispatcher.predict_leaf(
                 X, start_iteration, num_iteration
             )
+        if pred_contrib:
+            # contrib is an explanation endpoint, not a margin — no
+            # objective transform, no queue coalescing (its ladder cap
+            # differs); always the primary replica's tables
+            return mv.dispatcher.predict_contrib(
+                X, start_iteration, num_iteration
+            )
         batcher = None
         if via_queue and start_iteration == 0 and num_iteration == -1:
-            with self._lock:
-                # re-check registration under the lock: a concurrent
-                # unload() must not have its version resurrected with a
-                # fresh worker thread nothing would ever close
-                registered = any(
-                    m is mv for m in self._models.get(name, [])
-                )
-                if registered:
-                    if mv.batcher is None:
-                        from .dispatch import MicroBatcher
-
-                        mv.batcher = MicroBatcher(
-                            mv.dispatcher,
-                            deadline_s=self.deadline_s,
-                            queue_cap=self.queue_cap,
-                        )
-                    batcher = mv.batcher
+            batcher = self._batcher_for(name, mv)
         if batcher is not None:
             # per-request deadline overrides the registry default;
             # QueueOverflow / DeadlineExceeded propagate to the caller
             raw = batcher.submit(X, deadline_s=deadline_s).result().T
         else:
-            raw = mv.dispatcher.score_raw(X, start_iteration, num_iteration)
+            d = mv.dispatcher
+            if len(mv.replicas) > 1:
+                with self._lock:
+                    self._rr += 1
+                    d = mv.replicas[self._rr % len(mv.replicas)]
+            raw = d.score_raw(X, start_iteration, num_iteration)
         g = mv.booster._gbdt
         if not raw_score and g.objective is not None:
             raw = g.objective.convert_output(raw)

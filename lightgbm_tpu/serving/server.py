@@ -17,10 +17,13 @@ Two transports over ONE request vocabulary (docs/SERVING.md):
 Request ops:
   {"op": "score", "model": "m", "rows": [[...], ...],
    "raw_score": false, "num_iteration": -1, "pred_leaf": false}
-  {"op": "load", "model": "m", "path": "model.txt"}   # or "model_str"
+  {"op": "contrib", "model": "m", "rows": [[...], ...]}  # SHAP values
+  {"op": "load", "model": "m", "path": "model.txt"}   # or "model_str";
+   fleet registries also honor "deadline_ms" / "queue_cap" QoS here
   {"op": "swap", "model": "m", "version": 2}
   {"op": "rollback", "model": "m"}
   {"op": "models"} / {"op": "stats"} / {"op": "ping"} / {"op": "quit"}
+  {"op": "fleet"}  # fleet residency stats (ModelFleet registries)
 
 Responses: {"ok": true, ...} or {"ok": false, "error": "..."}; scores
 ride as nested lists, latency from timer.latency_stats rides in
@@ -93,11 +96,16 @@ def _handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, A
             src = req.get("model_str") or req.get("path")
             if not src:
                 raise ValueError("load needs 'path' or 'model_str'")
-            v = registry.load(
-                req.get("model", "default"), src,
-                warmup=req.get("warmup"),
-                num_features=req.get("num_features"),
-            )
+            kwargs: Dict[str, Any] = {
+                "warmup": req.get("warmup"),
+                "num_features": req.get("num_features"),
+            }
+            # per-tenant QoS rides the load op (fleet registries honor
+            # it; the plain registry would reject unknown kwargs)
+            for k in ("deadline_ms", "queue_cap"):
+                if req.get(k) is not None:
+                    kwargs[k] = req[k]
+            v = registry.load(req.get("model", "default"), src, **kwargs)
             return {"ok": True, "version": v}
         if op == "swap":
             registry.swap(req["model"], int(req["version"]))
@@ -105,7 +113,7 @@ def _handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, A
         if op == "rollback":
             v = registry.rollback(req["model"])
             return {"ok": True, "active": v}
-        if op == "score":
+        if op in ("score", "contrib"):
             rows = np.asarray(req["rows"], np.float32)
             dl_ms = req.get("deadline_ms")
             pred = registry.predict(
@@ -114,12 +122,18 @@ def _handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, A
                 start_iteration=int(req.get("start_iteration", 0)),
                 num_iteration=int(req.get("num_iteration", -1)),
                 pred_leaf=bool(req.get("pred_leaf", False)),
+                pred_contrib=(op == "contrib"
+                              or bool(req.get("pred_contrib", False))),
                 via_queue=bool(req.get("queue", False)),
                 version=req.get("version"),
                 deadline_s=(float(dl_ms) / 1000.0
                             if dl_ms is not None else None),
             )
             return {"ok": True, "pred": np.asarray(pred).tolist()}
+        if op == "fleet":
+            if not hasattr(registry, "fleet_stats"):
+                raise ValueError("not a fleet registry")
+            return {"ok": True, "fleet": registry.fleet_stats()}
         if op == "quit":
             return {"ok": True, "quit": True}
         raise ValueError(f"unknown op {op!r}")
@@ -220,6 +234,8 @@ def serve_http(registry: ModelRegistry, port: int,
                 self._reply(handle_request(registry, {"op": "models"}))
             elif self.path == "/v1/stats":
                 self._reply(handle_request(registry, {"op": "stats"}))
+            elif self.path == "/v1/fleet":
+                self._reply(handle_request(registry, {"op": "fleet"}))
             else:
                 self._reply({"ok": False, "error": "not found"}, 404)
 

@@ -255,12 +255,14 @@ _latency: Dict[str, LatencyStats] = {}
 _latency_lock = threading.Lock()
 
 
-def latency_stats(name: str) -> LatencyStats:
+def latency_stats(name: str, model: Optional[str] = None) -> LatencyStats:
     """Named process-global LatencyStats (one per serving entry point,
     mirroring global_timer's named-scope registry). Each named ring
     registers itself on the obs metrics registry at creation, so
     `/metrics` scrapes and `ModelRegistry.stats()` read the SAME
-    object — one source of truth for serving latency."""
+    object — one source of truth for serving latency. ``model`` tags
+    the exported series with a ``{model=...}`` label (fleet tenants;
+    docs/OBSERVABILITY.md)."""
     with _latency_lock:
         created = name not in _latency
         if created:
@@ -269,7 +271,7 @@ def latency_stats(name: str) -> LatencyStats:
     if created:
         from .obs.metrics import register_latency_collector
 
-        register_latency_collector(name, stats)
+        register_latency_collector(name, stats, model=model)
     return stats
 
 

@@ -1,0 +1,451 @@
+"""Fleet serving (lightgbm_tpu/serving/fleet.py + the multi-replica /
+device-TreeSHAP extensions to forest/dispatch/registry).
+
+Contracts under test:
+
+- **stacked scoring**: models paged into one family stack score
+  identically to their own boosters, and paging a different model into
+  a slot never recompiles (the slot index is traced, the stack shapes
+  are the executable's identity);
+- **LRU paging**: a fleet larger than its residency capacity keeps
+  ``resident <= capacity``, evicts least-recently-used, and re-paged
+  models still score exactly;
+- **hot-swap atomicity**: readers hammering a model THROUGH a v1->v2
+  swap (while a cold model pages in beside them) each get a result
+  bit-equal to v1 or v2 — never a torn table, a dropped future, or
+  another model's scores;
+- **device TreeSHAP**: ``pred_contrib`` computed on-device over the
+  packed tables matches the host ``shap.py`` oracle on every model
+  family, and rows sum to the raw score (non-linear trees);
+- **replicas**: N predictor replicas behind one registry answer
+  bit-identically to a single replica, direct and via the
+  continuous-batching front.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import shap as host_shap
+from lightgbm_tpu.serving import (
+    MicroBatcher,
+    ModelFleet,
+    ModelRegistry,
+    TensorForest,
+)
+
+
+def _train(params, X, y, rounds=8, **ds_kw):
+    ds = lgb.Dataset(X, label=y, free_raw_data=False, **ds_kw)
+    p = dict(verbosity=-1, min_data_in_leaf=5, deterministic=True)
+    p.update(params)
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+def _reg_booster(rng, seed=0, leaves=15, rounds=8, feats=6, depth=None):
+    r = np.random.RandomState(seed)
+    X = r.randn(500, feats)
+    y = X[:, 0] * (seed % 5 + 1) + X[:, 1] + 0.1 * r.randn(500)
+    p = {"objective": "regression", "num_leaves": leaves}
+    if depth is not None:
+        p["max_depth"] = depth
+    return _train(p, X, y, rounds=rounds)
+
+
+def _contrib_families(rng):
+    """(name, booster, query matrix) across the model families the
+    device TreeSHAP must explain (docs/SERVING.md)."""
+    out = []
+    X = rng.randn(900, 8)
+    yreg = X @ rng.randn(8) + 0.1 * rng.randn(900)
+    out.append(("regression",
+                _train({"objective": "regression", "num_leaves": 15},
+                       X, yreg, rounds=10),
+                rng.randn(80, 8)))
+
+    Xc = rng.randn(900, 8)
+    Xc[:, 3] = rng.randint(0, 8, 900)
+    Xc[rng.rand(900) < 0.08, 1] = np.nan
+    yb = (np.nan_to_num(Xc[:, 0]) + (Xc[:, 3] % 3 == 0) > 0.3).astype(float)
+    Xq = rng.randn(80, 8)
+    Xq[:, 3] = rng.randint(0, 8, 80)
+    Xq[rng.rand(80) < 0.08, 1] = np.nan
+    out.append(("binary+cat+nan",
+                _train({"objective": "binary", "num_leaves": 15}, Xc, yb,
+                       rounds=10, categorical_feature=[3]),
+                Xq))
+
+    ym = rng.randint(0, 3, 900)
+    out.append(("multiclass",
+                _train({"objective": "multiclass", "num_class": 3,
+                        "num_leaves": 15}, X, ym, rounds=6),
+                rng.randn(60, 8)))
+
+    Xl = rng.randn(800, 5)
+    yl = Xl[:, 0] * 2 + Xl[:, 1] + 0.1 * rng.randn(800)
+    dsl = lgb.Dataset(Xl, label=yl, free_raw_data=False,
+                      params={"linear_tree": True})
+    bl = lgb.train({"objective": "regression", "num_leaves": 15,
+                    "linear_tree": True, "verbosity": -1,
+                    "min_data_in_leaf": 5}, dsl, num_boost_round=6)
+    out.append(("linear_tree", bl, rng.randn(60, 5)))
+    return out
+
+
+def _host_contrib(bst, Xq):
+    g = bst._gbdt
+    return host_shap.predict_contrib(
+        list(g.models), np.asarray(Xq, np.float64), Xq.shape[1],
+        g.num_class, 0, -1, bool(getattr(g, "average_output", False)),
+    )
+
+
+# ---------------------------------------------------------- TreeSHAP
+def test_device_contrib_parity_all_families(rng):
+    """On-device pred_contrib vs the host shap.py oracle: Booster
+    layout (N, K*(F+1)), every family; rows sum to the raw score for
+    constant-leaf trees (linear leaves attribute via leaf constants —
+    the oracle's semantics — so their row-sum check is skipped)."""
+    for name, bst, Xq in _contrib_families(rng):
+        host = _host_contrib(bst, Xq)
+        forest = TensorForest.from_booster(bst)
+        dev = forest.predict_contrib(np.asarray(Xq, np.float32))
+        assert dev.shape == host.shape, name
+        scale = max(1.0, np.max(np.abs(host)))
+        assert np.max(np.abs(dev - host)) / scale < 5e-4, name
+        if name == "linear_tree":
+            continue
+        raw = bst.predict(Xq, raw_score=True)
+        raw = raw if raw.ndim == 2 else raw[:, None]
+        K = max(bst._gbdt.num_class, 1)
+        sums = dev.reshape(len(Xq), K, Xq.shape[1] + 1).sum(axis=2)
+        np.testing.assert_allclose(sums, raw, rtol=1e-4, atol=2e-3,
+                                   err_msg=name)
+
+
+def test_device_contrib_truncation_and_registry_endpoint(rng):
+    """Iteration truncation matches the truncated host oracle, and the
+    registry/fleet pred_contrib endpoints return the device values."""
+    bst = _reg_booster(rng, seed=3, rounds=10)
+    Xq = np.random.RandomState(1).randn(40, 6).astype(np.float32)
+    forest = TensorForest.from_booster(bst)
+    g = bst._gbdt
+    for start, num in ((0, 4), (2, 5)):
+        host = host_shap.predict_contrib(
+            list(g.models)[start:start + num], np.asarray(Xq, np.float64),
+            6, g.num_class, 0, -1, False,
+        )
+        dev = forest.predict_contrib(Xq, start, num)
+        assert np.max(np.abs(dev - host)) < 5e-4, (start, num)
+
+    reg = ModelRegistry()
+    reg.load("m", bst)
+    via_reg = reg.predict("m", Xq, pred_contrib=True)
+    assert np.max(np.abs(via_reg - _host_contrib(bst, Xq))) < 5e-4
+
+
+# ---------------------------------------------------- stacked scoring
+def test_fleet_stacked_parity_and_no_repage_recompile(retrace_guard, rng):
+    """Different-shaped models of one family share one stack
+    executable: paging model after model into the stack never
+    recompiles (the slot is traced data, not a trace constant)."""
+    from lightgbm_tpu.serving.forest import _stacked_apply_jit
+
+    fleet = ModelFleet(buckets=(16, 64), capacity=2, slots_per_family=2)
+    # max_depth pinned: the family key pads trees/nodes/leaves to pow2
+    # but keys on the depth bound, so equal depth = one family
+    boosters = {
+        f"m{i}": _reg_booster(rng, seed=i, leaves=6 + (i % 3),
+                              rounds=5 + i, depth=3)
+        for i in range(4)
+    }
+    names = list(boosters)
+    for name in names:
+        fleet.load(name, boosters[name])
+    Xq = np.random.RandomState(7).randn(30, 6)
+    try:
+        for name in names:  # pages everything once: compiles happen here
+            fleet.predict(name, Xq)
+        # the point of the pow2-padded family key: these four different
+        # models (different leaf/tree counts) share ONE stack family
+        assert len(fleet._stacks) == 1, list(fleet._stacks)
+        with retrace_guard(
+            entry_points=[_stacked_apply_jit()], max_retraces=0,
+            what="fleet paging across 4 models (2 resident slots)",
+        ):
+            for _ in range(2):
+                for name in names:
+                    got = fleet.predict(name, Xq)
+                    ref = boosters[name].predict(Xq)
+                    np.testing.assert_allclose(got, ref, rtol=1e-6,
+                                               atol=1e-6, err_msg=name)
+    finally:
+        fleet.close()
+
+
+def test_fleet_lru_paging_and_metrics(rng):
+    """resident <= capacity always; LRU eviction under a sweep larger
+    than capacity; evicted models re-page and still score exactly; the
+    pager's traffic lands in the per-model metrics registry."""
+    from lightgbm_tpu.obs.metrics import default_registry
+
+    fleet = ModelFleet(buckets=(16, 64), capacity=3, slots_per_family=2)
+    boosters = {f"m{i}": _reg_booster(rng, seed=10 + i) for i in range(6)}
+    for name, b in boosters.items():
+        fleet.load(name, b)
+    Xq = np.random.RandomState(3).randn(20, 6)
+    try:
+        for sweep in range(2):
+            for name, b in boosters.items():
+                got = fleet.predict(name, Xq)
+                np.testing.assert_allclose(got, b.predict(Xq), rtol=1e-6,
+                                           atol=1e-6, err_msg=name)
+                assert fleet.fleet_stats()["resident"] <= 3
+        fs = fleet.fleet_stats()
+        assert fs["capacity"] == 3
+        assert fs["evictions"] > 0, "LRU never exercised"
+        assert fs["pages_in"] > len(boosters), "no re-paging happened"
+        snap = default_registry().snapshot()
+        pages = snap.get("lgbmtpu_fleet_page_events_total", {})
+        assert any('model="m0"' in k and 'event="page_in"' in k
+                   for k in pages), pages.keys()
+        reqs = snap.get("lgbmtpu_serve_requests_total", {})
+        assert any('model="m0"' in k for k in reqs), reqs.keys()
+        assert "lgbmtpu_fleet_resident_models" in snap
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------- swap under load
+def test_fleet_swap_rollback_atomic_under_concurrent_load(rng):
+    """The satellite contract: reader threads hammer model A straight
+    through a v1->v2 hot swap while a cold model B pages in beside
+    them. Every single result must be bit-equal to either v1's or v2's
+    full prediction — no torn model, no dropped request, no
+    other-model scores — and after rollback the fleet answers v1
+    again."""
+    fleet = ModelFleet(buckets=(16,), capacity=2, slots_per_family=2)
+    b1 = _reg_booster(rng, seed=21, leaves=12, rounds=6)
+    b2 = _reg_booster(rng, seed=22, leaves=12, rounds=6)
+    bcold = _reg_booster(rng, seed=23, leaves=12, rounds=6)
+    Xq = np.random.RandomState(5).randn(16, 6)
+    refc = bcold.predict(Xq)
+
+    # bit-level references must come off the SAME stacked executable
+    # the fleet runs (float32 device math, not the float64 host walk):
+    # a scratch fleet of the same family produces bit-identical output
+    scratch = ModelFleet(buckets=(16,), capacity=2, slots_per_family=2)
+    scratch.load("r1", b1)
+    scratch.load("r2", b2)
+    ref1 = np.asarray(scratch.predict("r1", Xq))
+    ref2 = np.asarray(scratch.predict("r2", Xq))
+    scratch.close()
+    assert np.max(np.abs(ref1 - ref2)) > 1e-3  # distinguishable models
+
+    fleet.load("A", b1)
+    np.testing.assert_array_equal(fleet.predict("A", Xq), ref1)
+    errors: list = []
+    torn: list = []
+    stop = threading.Event()
+
+    def hammer(seed: int) -> None:
+        try:
+            while not stop.is_set():
+                got = fleet.predict("A", Xq)
+                if not (np.array_equal(got, ref1)
+                        or np.array_equal(got, ref2)):
+                    torn.append(got)
+                    return
+        except Exception as e:  # noqa: BLE001 — collected and re-raised below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,), daemon=True)
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        v2 = fleet.load("A", b2, activate=False)
+        fleet.load("B", bcold)          # cold page-in during the storm
+        np.testing.assert_allclose(fleet.predict("B", Xq), refc,
+                                   rtol=1e-6, atol=1e-6)
+        fleet.swap("A", v2)
+        # give readers time to cross the swap boundary
+        for _ in range(20):
+            fleet.predict("A", Xq)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    assert not torn, "torn/foreign prediction observed during swap"
+    np.testing.assert_array_equal(fleet.predict("A", Xq), ref2)
+    assert fleet.rollback("A") == 1
+    np.testing.assert_array_equal(fleet.predict("A", Xq), ref1)
+    fleet.close()
+
+
+def test_fleet_qos_and_residency_rejection(rng):
+    """Per-tenant QoS rides load(): a model with a tiny queue_cap
+    rejects a backlog with QueueOverflow (HTTP 503), and a fleet whose
+    residency is exhausted by pinned models rejects rather than
+    deadlocks."""
+    from lightgbm_tpu.resilience.errors import QueueOverflow
+
+    fleet = ModelFleet(buckets=(16,), capacity=1, slots_per_family=1,
+                       page_timeout_s=0.2)
+    fleet.load("a", _reg_booster(rng, seed=31))
+    fleet.load("b", _reg_booster(rng, seed=32))
+    Xq = np.random.RandomState(9).randn(8, 6)
+    fleet.predict("a", Xq)
+
+    # pin "a" by holding its residency from inside a predict: simulate
+    # by paging "b" while "a" is the sole resident — with capacity 1
+    # and no pins this must evict and succeed, proving the timeout
+    # path only fires for genuinely pinned stacks
+    fleet.predict("b", Xq)
+    assert fleet.fleet_stats()["resident"] == 1
+
+    # per-tenant QoS rides load(): the tenant's continuous-batching
+    # front is built with ITS deadline/queue bound, not the fleet's
+    v = fleet.load("q", _reg_booster(rng, seed=33), queue_cap=3,
+                   deadline_ms=2500)
+    assert v == 1
+    fleet.predict("q", Xq, via_queue=True)  # builds the tenant batcher
+    entry = fleet._names["q"]["versions"][0]
+    assert entry.batcher.queue_cap == 3
+    assert entry.batcher.deadline_s == pytest.approx(2.5)
+    # admission control enforces that bound: with a backlog present, a
+    # request overflowing 3 rows is rejected (maps to HTTP 503)
+    with pytest.raises(QueueOverflow):
+        entry.batcher._pending.append(
+            (np.zeros((1, 6), np.float32), object(), None))
+        entry.batcher._pending_rows += 1
+        try:
+            fleet.predict("q", Xq, via_queue=True)
+        finally:
+            entry.batcher._pending.pop()
+            entry.batcher._pending_rows -= 1
+    fleet.close()
+
+
+# ------------------------------------------------------- replicas
+def test_registry_replicas_bit_identical_and_coalesced(rng):
+    """N replicas behind one registry: concurrent direct and queued
+    traffic answers bit-identically to a single-replica registry, and
+    the continuous-batching front drains through every replica."""
+    bst = _reg_booster(rng, seed=41, rounds=10)
+    Xq = np.random.RandomState(11).randn(24, 6).astype(np.float32)
+    single = ModelRegistry()
+    single.load("m", bst)
+    ref = np.asarray(single.predict("m", Xq))
+
+    reg = ModelRegistry(replicas=3)
+    reg.load("m", bst)
+    mv = reg._entry("m")
+    assert len(mv.replicas) == 3
+    results: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def worker(i: int) -> None:
+        try:
+            mine = []
+            for j in range(8):
+                got = reg.predict("m", Xq, via_queue=(j % 2 == 0))
+                mine.append(np.asarray(got))
+            with lock:
+                results.extend(mine)
+        except Exception as e:  # noqa: BLE001 — collected and re-raised below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert len(results) == 64
+    for got in results:
+        np.testing.assert_array_equal(got, ref)
+    # the shared batcher fronts all three replicas
+    assert len(reg.batcher("m").dispatchers) == 3
+    reg.unload("m")  # closes the batcher workers
+
+
+def test_registry_batcher_accessor_and_multi_dispatcher_close(rng):
+    """registry.batcher() hands out the SAME continuous-batching front
+    predict(via_queue=True) uses; submit() resolves to raw margins;
+    a multi-dispatcher MicroBatcher joins every worker on close."""
+    bst = _reg_booster(rng, seed=51)
+    Xq = np.random.RandomState(13).randn(10, 6).astype(np.float32)
+    reg = ModelRegistry(replicas=2)
+    reg.load("m", bst)
+    b = reg.batcher("m")
+    assert b is reg.batcher("m")
+    raw = np.asarray(b.submit(Xq).result())
+    ref = np.asarray(reg.predict("m", Xq, raw_score=True))
+    np.testing.assert_array_equal(raw.reshape(-1), ref.reshape(-1))
+
+    disp = [r for r in reg._entry("m").replicas]
+    mb = MicroBatcher(disp)
+    assert len(mb._workers) == 2
+    futs = [mb.submit(Xq) for _ in range(6)]
+    for f in futs:
+        np.testing.assert_array_equal(
+            np.asarray(f.result()).reshape(-1), ref.reshape(-1))
+    mb.close()
+    for w in mb._workers:
+        assert not w.is_alive()
+
+
+# ----------------------------------------------------------- HTTP
+def test_fleet_over_http(rng):
+    """The fleet behind the HTTP front end: QoS-tagged load, score,
+    the contrib op, /v1/fleet residency stats, and per-model series on
+    /metrics."""
+    import urllib.request
+
+    from lightgbm_tpu.serving import serve_http
+
+    bst = _reg_booster(rng, seed=61)
+    fleet = ModelFleet(buckets=(16,), capacity=4)
+    httpd = serve_http(fleet, port=0, block=False)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    Xq = np.random.RandomState(17).randn(6, 6)
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        out = post("/v1/load", {"model": "h", "model_str":
+                                bst.model_to_string(),
+                                "deadline_ms": 2000, "queue_cap": 4096})
+        assert out["version"] == 1
+        out = post("/v1/score", {"model": "h", "rows": Xq.tolist()})
+        np.testing.assert_allclose(out["pred"], bst.predict(Xq),
+                                   rtol=1e-5, atol=1e-6)
+        out = post("/v1/contrib", {"model": "h", "rows": Xq.tolist()})
+        host = _host_contrib(bst, Xq)
+        assert np.max(np.abs(np.asarray(out["pred"]) - host)) < 5e-4
+        with urllib.request.urlopen(base + "/v1/fleet", timeout=30) as r:
+            fl = json.loads(r.read())["fleet"]
+        assert fl["resident"] >= 1 and fl["capacity"] == 4
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert 'model="h"' in text
+        assert "lgbmtpu_fleet_resident_models" in text
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=5)
+        fleet.close()

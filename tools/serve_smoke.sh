@@ -3,8 +3,11 @@
 # through the CLI, start the task=serve JSONL loop, score a batch
 # through it, and assert parity against Booster.predict on the same
 # model file; then bring up the HTTP transport and assert /healthz +
-# /metrics Prometheus exposition (docs/OBSERVABILITY.md). Runs on the
-# CPU backend so it is safe anywhere.
+# /metrics Prometheus exposition (docs/OBSERVABILITY.md); then a fleet
+# smoke — ~100 models hot-loaded under serve_fleet=true with a small
+# residency capacity, scored so the LRU pager churns, one hot-swap,
+# one device-TreeSHAP contrib request, and a /metrics scrape asserting
+# per-model series. Runs on the CPU backend so it is safe anywhere.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -125,3 +128,120 @@ finally:
     except subprocess.TimeoutExpired:
         proc.kill()
 EOF2
+
+# Fleet smoke (docs/SERVING.md "Fleet serving"): ~100 tenants behind
+# one HTTP fleet with residency capacity << fleet size. Asserts: every
+# model scores correctly cold or resident, resident stays under the
+# cap while evictions climb, hot-swap + contrib work under the fleet,
+# and /metrics carries per-model series + the pager gauges.
+python - "$WORK" <<'EOF3'
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+work = sys.argv[1]
+FLEET = 100
+CAPACITY = 12
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]
+s.close()
+proc = subprocess.Popen(
+    [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+     f"input_model={work}/model.txt", f"serve_port={port}",
+     "serve_fleet=true", f"serve_fleet_capacity={CAPACITY}",
+     "serve_buckets=16,64", "verbosity=-1"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+)
+base = f"http://127.0.0.1:{port}"
+
+
+def post(path, body, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+try:
+    for _ in range(240):
+        if proc.poll() is not None:
+            raise SystemExit(
+                f"fleet serve exited early: {proc.stderr.read()[-2000:]}")
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=2) as r:
+                assert json.loads(r.read())["ok"]
+            break
+        except OSError:
+            time.sleep(0.5)
+    else:
+        raise SystemExit("fleet serve_http never became healthy")
+
+    model_str = open(f"{work}/model.txt").read()
+    import lightgbm_tpu as lgb
+
+    bst = lgb.Booster(model_str=model_str)
+    rows = np.loadtxt(f"{work}/score.csv", delimiter=",")[:16]
+    host = bst.predict(rows)
+
+    for i in range(FLEET):
+        out = post("/v1/load", {"model": f"tenant{i:03d}",
+                                "model_str": model_str,
+                                "deadline_ms": 10000})
+        assert out["ok"] and out["version"] == 1, out
+    # score every tenant: only CAPACITY can be resident, so this sweep
+    # forces ~FLEET-CAPACITY LRU page-outs and every cold hit re-pages
+    for i in range(FLEET):
+        out = post(f"/v1/score", {"model": f"tenant{i:03d}",
+                                  "rows": rows.tolist()})
+        err = float(np.abs(np.asarray(out["pred"]) - host).max())
+        assert err < 1e-5, f"tenant{i:03d} mismatch: {err}"
+
+    with urllib.request.urlopen(base + "/v1/fleet", timeout=30) as r:
+        fl = json.loads(r.read())["fleet"]
+    assert fl["models"] >= FLEET, fl  # +1: the CLI's input_model tenant
+    assert fl["capacity"] == CAPACITY, fl
+    assert fl["resident"] <= CAPACITY < FLEET, fl
+    assert fl["evictions"] >= FLEET - CAPACITY, fl
+    assert fl["pages_in"] >= FLEET, fl
+
+    # hot-swap one tenant to a fresh version and roll it back
+    out = post("/v1/load", {"model": "tenant000", "model_str": model_str})
+    assert out["version"] == 2, out
+    out = post("/v1/score", {"model": "tenant000", "rows": rows.tolist()})
+    assert out["ok"], out
+    out = post("/v1/rollback", {"model": "tenant000"})
+    assert out["active"] == 1, out
+
+    # device TreeSHAP through the fleet: contributions sum to the
+    # booster's raw score per row
+    out = post("/v1/contrib", {"model": "tenant001",
+                               "rows": rows.tolist()})
+    contrib = np.asarray(out["pred"])
+    assert contrib.shape == (len(rows), rows.shape[1] + 1), contrib.shape
+    raw = bst.predict(rows, raw_score=True)
+    serr = float(np.abs(contrib.sum(axis=1) - raw).max())
+    assert serr < 1e-3, f"contrib row-sum mismatch: {serr}"
+
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert 'model="tenant000"' in text, text[:500]
+    assert "lgbmtpu_fleet_page_events_total" in text
+    assert "lgbmtpu_fleet_resident_models" in text
+    print(f"serve_smoke fleet: OK ({FLEET} tenants, capacity {CAPACITY}, "
+          f"resident {fl['resident']}, pages_in {fl['pages_in']}, "
+          f"evictions {fl['evictions']})")
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+EOF3
