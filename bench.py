@@ -36,6 +36,10 @@ Env overrides: BENCH_ROWS, BENCH_FEATURES, BENCH_LEAVES, BENCH_TREES,
 BENCH_WARMUP, BENCH_MAX_BIN, BENCH_PROBE_TIMEOUT (s), BENCH_PROBE_RETRIES,
 BENCH_FORCE_CPU, BENCH_CPU_ROWS, BENCH_GROWTH_MODE,
 BENCH_BUDGET (s, SIGALRM deadline), BENCH_RUN_DIR (partial-state dir).
+Voting segment (needs a multi-device mesh, e.g.
+XLA_FLAGS=--xla_force_host_platform_device_count=8 on CPU):
+BENCH_SKIP_VOTING, BENCH_VOTING_TREES, BENCH_VOTING_EXACT_TREES,
+BENCH_VOTING_LEAVES, BENCH_VOTING_TOPK.
 """
 
 import importlib.util
@@ -186,6 +190,9 @@ def _final_json():
     for k in ("auc_valid", "trees_done", "warmup_s", "growth_mode",
               "total_trees_per_sec", "quantized", "quantized_trees_per_sec",
               "quantized_total_trees_per_sec", "quantized_auc_valid",
+              "voting_trees_per_sec", "voting_exact_trees_per_sec",
+              "voting_speedup_vs_exact", "voting_auc_valid",
+              "voting_leaves", "voting_devices",
               "run_id", "run_manifest"):
         if k in _STATE:
             out[k] = _STATE[k]
@@ -501,6 +508,54 @@ def main() -> None:
                 save_partial(quantized_auc_valid=qauc)
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] quantized segment failed: {e}\n")
+
+    # third segment: voting-parallel (tree_learner=voting riding the
+    # rounds grower) against the sequential exact oracle
+    # (tpu_growth_mode=exact, permuted.py) on the SAME dataset and leaf
+    # budget — so the reported speedup is a same-run measurement, not a
+    # cross-artifact quote. The election is a cross-shard psum, so the
+    # segment needs a device mesh (on CPU:
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8); both sides
+    # downshift leaves (BENCH_VOTING_LEAVES) because the oracle pays one
+    # dispatched step per SPLIT and would otherwise eat the budget.
+    if not os.environ.get("BENCH_SKIP_VOTING"):
+        import jax
+
+        if jax.device_count() > 1:
+            vtrees = int(os.environ.get("BENCH_VOTING_TREES",
+                                        min(trees, 15)))
+            etrees = int(os.environ.get("BENCH_VOTING_EXACT_TREES", 2))
+            vleaves = int(os.environ.get("BENCH_VOTING_LEAVES",
+                                         min(leaves, 63)))
+            vparams = dict(params, tree_learner="voting",
+                           top_k=int(os.environ.get("BENCH_VOTING_TOPK", 8)),
+                           num_leaves=vleaves, tpu_growth_mode="rounds")
+            save_partial(stage="voting", voting_leaves=vleaves,
+                         voting_devices=jax.device_count())
+            try:
+                vsteady, vtotal, vauc = timed_train(
+                    vparams, vtrees, tag="voting ")
+                vtps = vsteady or vtotal
+                save_partial(voting_trees_per_sec=round(vtps, 4))
+                if vauc is not None:
+                    save_partial(voting_auc_valid=vauc)
+                esteady, etotal, _ = timed_train(
+                    dict(vparams, tpu_growth_mode="exact"), etrees,
+                    tag="voting-exact ")
+                etps = esteady or etotal
+                save_partial(
+                    voting_exact_trees_per_sec=round(etps, 4),
+                    voting_speedup_vs_exact=(
+                        round(vtps / etps, 2) if etps else None),
+                )
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"[bench] voting segment failed: {e}\n")
+        else:
+            sys.stderr.write(
+                "[bench] voting segment skipped: single-device run (set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8 for "
+                "a host mesh)\n"
+            )
 
     write_run_manifest(params)
     _STATE["stage"] = "done"

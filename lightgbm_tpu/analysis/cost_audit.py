@@ -243,18 +243,27 @@ def audit_cost(summary: CostSummary, budget: Optional[Dict[str, Any]],
 # still budget-ratchets on its own).
 _DROP_PAIRS: Dict[str, str] = {"hist_round_fused": "hist_round_fused_bf16"}
 
+# same contract shape on the WIRE account (ISSUE 14 satellite): the
+# voting-parallel entry's collective payload (votes + elected-columns
+# psum) must land strictly below the all-feature reduce-scatter wire of
+# the plain quantized data-parallel entry — the whole point of the
+# election is moving less histogram across the mesh, and both sides are
+# measured this run off the same jaxpr walker.
+_WIRE_DROP_PAIRS: Dict[str, str] = {"rounds_voting": "rounds_quant_rs"}
 
-def audit_bytes_drop(name: str, got: int, base: str,
-                     ref: int) -> Contract:
-    """`name` must access strictly fewer compiled bytes than `base`
-    (both measured THIS run — no stale budget on either side)."""
+
+def audit_bytes_drop(name: str, got: int, base: str, ref: int,
+                     metric: str = "bytes") -> Contract:
+    """`name` must show strictly fewer `metric` (compiled bytes
+    accessed, or collective wire bytes) than `base` (both measured THIS
+    run — no stale budget on either side)."""
     ok = got < ref
     return Contract(
-        f"bytes_drop_vs_{base}", ok,
+        f"{metric}_drop_vs_{base}", ok,
         (f"{_fmt_bytes(got)} < {base}'s {_fmt_bytes(ref)} "
          f"({got / ref:.0%})" if ok else
          f"{_fmt_bytes(got)} does NOT undercut {base}'s "
-         f"{_fmt_bytes(ref)} — the narrow-channel path stopped being "
+         f"{_fmt_bytes(ref)} — the narrow path stopped being "
          "narrower"),
     )
 
@@ -296,16 +305,20 @@ def run_cost_audits(names: Optional[Sequence[str]] = None
             summaries[name], budgets.get(name), name,
             wire_dtype=ENTRIES[name].wire_dtype,
         )
-        base = _DROP_PAIRS.get(name)
-        if base is not None:
+        for pairs, metric in ((_DROP_PAIRS, "bytes"),
+                              (_WIRE_DROP_PAIRS, "wire_bytes")):
+            base = pairs.get(name)
+            if base is None:
+                continue
             # the baseline is measured this run even when the caller
             # filtered it out — a drop contract against a stale number
             # proves nothing
             if base not in summaries:
                 summaries[base] = compile_entry(base)
+            key = "bytes_accessed" if metric == "bytes" else "wire_bytes"
             c = audit_bytes_drop(
-                name, summaries[name].bytes_accessed,
-                base, summaries[base].bytes_accessed,
+                name, summaries[name].metric(key),
+                base, summaries[base].metric(key), metric=metric,
             )
             res = AuditResult(
                 name, res.ok and c.ok, res.contracts + [c], 0,

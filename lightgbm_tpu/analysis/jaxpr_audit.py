@@ -245,10 +245,13 @@ def _mesh8():
     return Mesh(np.asarray(devs), ("data",))
 
 
-def _trace_rounds_dp(quant: bool, levels: int, local_rows: int):
+def _trace_rounds_dp(quant: bool, levels: int, local_rows: int,
+                     voting_k: int = 0):
     """Abstract shard_map trace of the rounds grower over the data
     mesh — the exact wiring DataParallelGrower builds (shapes only; no
-    arrays exist, so `local_rows` can model pod scale for free)."""
+    arrays exist, so `local_rows` can model pod scale for free).
+    voting_k>0 turns on the per-round GlobalVoting election
+    (tree_learner=voting): only the elected columns cross the mesh."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -269,6 +272,7 @@ def _trace_rounds_dp(quant: bool, levels: int, local_rows: int):
         num_leaves=L, num_bins=B, max_depth=-1, axis_name="data",
         axis_size=n, rounds_slots=8, quant=quant,
         quant_levels=levels if quant else 0, has_cat=False,
+        voting_k=voting_k,
     )
     params = make_split_params(Config({}))
     mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
@@ -548,6 +552,24 @@ ENTRIES: Dict[str, _Entry] = {
         ],
         "quantized grower past the exactness bound: overflow gate "
         "engaged, f32 psum fallback",
+    ),
+    "rounds_voting": _Entry(
+        lambda: _trace_rounds_dp(**_RS_OK, voting_k=2),
+        lambda budget: [
+            has_prim("psum",
+                     "vote tally + elected-column payload cross the "
+                     "mesh (rounds.vote_reduce)"),
+            lacks_prim("reduce_scatter",
+                       "voting replaces the full-width owned-block "
+                       "wire; the elected ~2k columns ride psum"),
+            no_host_callbacks(),
+            no_f64(),
+            within_budget(budget),
+        ],
+        "voting-parallel rounds grower (tree_learner=voting): per-round "
+        "top-k election, only the elected bundle columns cross the mesh "
+        "— int16 payload while the quantized sums provably fit; "
+        "cost_audit pins the wire-bytes DROP vs rounds_quant_rs",
     ),
     "rounds_serial": _Entry(
         _trace_rounds_serial,

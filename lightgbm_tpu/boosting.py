@@ -296,8 +296,9 @@ class GBDT:
             if config.tree_learner == "voting":
                 log.info(
                     f"tree_learner=voting: top-{config.top_k} local-gain "
-                    "vote elects features per split; only elected columns "
-                    "are psum'd across the mesh "
+                    "vote elects features per round (per split on the "
+                    "exact oracle); only elected columns are psum'd "
+                    "across the mesh "
                     "(voting_parallel_tree_learner.cpp semantics)"
                 )
             self._mesh = make_mesh()
@@ -421,20 +422,11 @@ class GBDT:
             )
             if self._forced is not None:
                 n_forced = int(self._forced.leaf.shape[0])
-        if n_forced and use_voting:
-            # under voting only ELECTED feature columns of the pooled
-            # histogram hold globally-reduced values; a forced split
-            # reads its prescribed feature's column unconditionally and
-            # would consume stale per-shard sums below the root —
-            # disable the election rather than train wrong trees
-            # (same shape as the EFB guard above)
-            log.warning(
-                "tree_learner=voting is disabled because a forced-split "
-                "plan (forcedsplits_filename) reads histogram columns "
-                "the election would not reduce; falling back to full "
-                "histogram psum (tree_learner=data)."
-            )
-            use_voting = False
+        # voting + forced splits compose on the rounds grower: the
+        # forced plan's bundle columns are pinned into every election,
+        # so the prescribed features always carry globally-reduced
+        # sums (rounds.py vote_reduce; the old warn-and-disable guard
+        # predates the election pinning)
         if config.tpu_debug_check_split:
             self._force_sync = True  # the check reads back per iteration
             self._force_sync_reason = "tpu_debug_check_split reads back per iteration"
@@ -475,19 +467,22 @@ class GBDT:
             jax.random.key(config.extra_seed) if (use_extra or use_bynode)
             else None
         )
-        # ---- monotone constraint method: intermediate/advanced ride
-        # the sequential permuted grower with per-split bound
-        # recomputation (mono_mode=1); they exclude per-node extras and
-        # voting (the re-search ignores their per-node state)
+        # ---- monotone constraint method: 1 = intermediate (both the
+        # sequential permuted grower — per-split recompute — and the
+        # rounds grower — per-round recompute + conflict guard);
+        # 2 = advanced (per-leaf range-overlap refinement of the
+        # opposite-subtree extrema, rounds grower only). Both exclude
+        # per-node extras, forced splits and voting (the re-search
+        # ignores their per-node state / election masks).
         mono_any = (
             train_set.monotone_constraints is not None
             and np.any(np.asarray(train_set.monotone_constraints) != 0)
         )
-        mono_mode = int(
-            mono_any
-            and config.monotone_constraints_method in ("intermediate",
-                                                       "advanced")
-        )
+        mono_mode = 0
+        if mono_any:
+            mono_mode = {"intermediate": 1, "advanced": 2}.get(
+                config.monotone_constraints_method, 0
+            )
         if mono_mode and (use_extra or use_bynode or use_cegb or n_groups
                           or n_forced or use_voting
                           or self._parallel_mode == "feature"):
@@ -499,23 +494,16 @@ class GBDT:
             )
             mono_mode = 0
         # ---- growth strategy (tpu_growth_mode): natural-order
-        # round-batched growth is the TPU fast path; per-node extras,
-        # forced splits, voting and feature-parallel ride the sequential
-        # permuted grower (rounds.py module docstring has the
-        # semantics). Monotone constraints — basic AND intermediate —
-        # ride the rounds grower (VERDICT r4 item 3): basic via interval
-        # inheritance, intermediate via the per-round ancestry-matrix
-        # bounds recompute + full re-search with a same-round conflict
-        # guard (rounds.py).
-        # Per-node extras (extra_trees / feature_fraction_bynode / CEGB
-        # / interaction constraints) ride the rounds grower too
-        # (VERDICT r4 item 4); only voting, forced splits and
-        # feature-parallel still require the sequential permuted path.
-        rounds_ok = (
-            not use_voting
-            and self._parallel_mode != "feature"
-            and not n_forced
-        )
+        # round-batched growth is the single production grower
+        # (ISSUE 14). Monotone constraints (basic / intermediate /
+        # advanced), per-node extras (extra_trees /
+        # feature_fraction_bynode / CEGB / interaction constraints),
+        # voting-parallel (per-round election, elected columns only on
+        # the wire) and forced splits all ride it; only
+        # feature-parallel still requires the flat grower, and the
+        # sequential permuted grower remains as the reference-exact
+        # parity oracle behind tpu_growth_mode=exact.
+        rounds_ok = self._parallel_mode != "feature"
         mode = config.tpu_growth_mode
         try:
             on_tpu = jax.devices()[0].platform == "tpu"
@@ -528,10 +516,33 @@ class GBDT:
             if use_rounds and not rounds_ok:
                 log.warning(
                     "tpu_growth_mode=rounds is incompatible with "
-                    "forced splits / voting / tree_learner=feature; "
-                    "falling back to exact sequential growth"
+                    "tree_learner=feature; falling back to exact "
+                    "sequential growth"
                 )
                 use_rounds = False
+        if mono_mode == 2 and not use_rounds:
+            # the advanced range-overlap refinement lives in the rounds
+            # grower's per-round state; the sequential oracle implements
+            # intermediate only
+            log.warning(
+                "monotone_constraints_method=advanced rides the rounds "
+                "grower only (tpu_growth_mode=rounds); using "
+                "method=intermediate on the sequential path"
+            )
+            mono_mode = 1
+        if use_voting and n_forced and not use_rounds:
+            # the sequential oracle cannot pin forced columns into its
+            # per-split election (stale non-elected histogram columns
+            # would corrupt the forced splits; permuted.py raises on the
+            # combination) — keep the forced plan and drop the election,
+            # the pre-unification fallback
+            log.warning(
+                "tree_learner=voting with forcedsplits_filename composes "
+                "on the rounds grower (tpu_growth_mode=rounds pins the "
+                "forced columns into every election); the sequential "
+                "exact path runs with the election disabled"
+            )
+            use_voting = False
         # histogram channel-dtype policy (tpu_hist_dtype, ISSUE 12): on
         # the rounds path the DEFAULT (unquantized-API) trainer also
         # discretizes g/h per round to narrow integer levels and rides
@@ -557,11 +568,13 @@ class GBDT:
             cat_subset=cat_subset,
             efb=train_set.bundle_layout is not None,
             col_bins=train_set.col_bins,
-            # the PERMUTED batched mode still excludes per-node extras
-            # and monotone intermediate (permuted.py raises); the
-            # natural-order rounds grower is the path that supports them
+            # the PERMUTED batched mode still excludes per-node extras,
+            # monotone intermediate, voting and forced splits
+            # (permuted.py raises); the natural-order rounds grower is
+            # the path that supports them
             rounds=(config.tpu_growth_rounds and not use_rounds
                     and rounds_ok and not mono_mode
+                    and not use_voting and not n_forced
                     and not (use_extra or use_bynode or use_cegb
                              or n_groups)),
             # slot defaults are chip-tuned END TO END (BENCH_NOTES r4):
@@ -609,6 +622,22 @@ class GBDT:
             ),
         )
         self.params = make_split_params(config)
+        # ---- provenance for the flight recorder / run manifest
+        # (docs/OBSERVABILITY.md): which learner family actually trains
+        # after mode resolution, and the voting election footprint
+        g_dev = int(self.dev["bins"].shape[0])
+        self.tree_learner_resolved = (
+            "voting" if use_voting
+            else self._parallel_mode if self._parallel_mode in (
+                "data", "feature")
+            else "serial"
+        )
+        self.voting_elected_cols = (
+            min(2 * config.top_k + n_forced, g_dev) if use_voting else None
+        )
+        # per-tree wire estimate; refined by the data-parallel grower's
+        # voting-aware wire_bytes_per_tree once it exists (below)
+        self.voting_wire_bytes_est = None
         self.train = _ScoreSet(
             train_set,
             self._init_score_arr(train_set),
@@ -647,6 +676,10 @@ class GBDT:
                     self.config.bagging_freq = 0
 
             self._dp = DataParallelGrower(self._mesh, self.spec)
+            if use_voting:
+                self.voting_wire_bytes_est = self._dp.wire_bytes_per_tree(
+                    int(self.dev["bins"].shape[0])
+                )
             self.dev = self._dp.shard_inputs(self.dev)
             # free the unsharded device copies — this booster reads only
             # self.dev for the train set; other boosters re-push fresh

@@ -196,9 +196,11 @@ _PARAMS: Dict[str, _P] = {
     # top-k positive-gain leaves split per device step, slot-packed MXU
     # histograms, no row movement — ~an order of magnitude faster on
     # TPU, deviates from exact best-first only when num_leaves binds);
-    # "auto" (default) = rounds on TPU hardware when the config is
-    # compatible (no per-node extras / forced splits / voting), exact
-    # otherwise — so CPU test/parity runs keep reference-exact trees.
+    # "auto" (default) = rounds on TPU hardware unless the config
+    # requires another grower (tree_learner=feature rides the flat
+    # feature-parallel path), exact otherwise — so CPU test/parity
+    # runs keep reference-exact trees. Voting-parallel, forced splits,
+    # per-node extras and all monotone methods ride rounds.
     "tpu_growth_mode": ("auto", str, (),
                         lambda v: v in ("auto", "rounds", "exact")),
     # max leaves split per round in rounds mode; 0 = auto (25 = 5 gh
@@ -602,10 +604,11 @@ def warn_unimplemented(cfg: "Config") -> None:
             f"monotone_constraints_method={cfg.monotone_constraints_method} "
             "is unknown; using 'basic' (interval inheritance)"
         )
-    elif cfg.monotone_constraints_method == "advanced":
+    elif (cfg.monotone_constraints_method == "advanced"
+          and cfg.tpu_growth_mode == "exact"):
         log.warning(
-            "monotone_constraints_method=advanced uses the intermediate "
-            "formulation (opposite-subtree output extrema recomputed per "
-            "split); the reference's per-threshold refinement "
-            "(monotone_constraints.hpp:858) is not replicated"
+            "monotone_constraints_method=advanced rides the rounds "
+            "grower (per-leaf range-overlap refinement of the "
+            "opposite-subtree extrema, monotone_constraints.hpp:858); "
+            "tpu_growth_mode=exact uses the intermediate formulation"
         )

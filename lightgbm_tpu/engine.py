@@ -112,14 +112,33 @@ class _ObsHooks:
         )
         self._gh_rows = list(self._gbdt._last_gh_rows)
 
+    def _provenance(self) -> Dict[str, Any]:
+        """Per-round training-path provenance: resolved histogram
+        numerics plus the resolved tree learner, and the voting
+        election footprint when the elected-columns-only wire is
+        active (ISSUE 14 — lets recorder output distinguish the
+        voting-on-rounds path from a full-histogram run)."""
+        g = self._gbdt
+        out: Dict[str, Any] = {
+            # resolved histogram channel layout — numerics provenance
+            # per round (the int-packed path changes per-tree math)
+            "hist_dtype": getattr(g, "hist_dtype", None),
+            "tree_learner": getattr(g, "tree_learner_resolved", None),
+        }
+        ec = getattr(g, "voting_elected_cols", None)
+        if ec is not None:
+            out["voting_elected_cols"] = ec
+            out["voting_wire_bytes_est"] = getattr(
+                g, "voting_wire_bytes_est", None
+            )
+        return out
+
     def fused_round(self, i: int, j: int, evals) -> None:
         from .boosting import FUSED_ROUND_PHASE
 
         rec: Dict[str, Any] = {
             "round": self.round_offset + i, "t_unix": time.time(),
-            # resolved histogram channel layout — numerics provenance
-            # per round (the int-packed path changes per-tree math)
-            "hist_dtype": getattr(self._gbdt, "hist_dtype", None),
+            **self._provenance(),
         }
         if j < len(self._step_durs):
             rec["phases"] = {
@@ -143,7 +162,7 @@ class _ObsHooks:
     def eager_round(self, i: int, evals, iter_seconds: float) -> None:
         rec: Dict[str, Any] = {
             "round": self.round_offset + i, "t_unix": time.time(),
-            "hist_dtype": getattr(self._gbdt, "hist_dtype", None),
+            **self._provenance(),
         }
         drained = self.recorder.drain_phases()
         if drained:

@@ -135,16 +135,20 @@ class GrowerSpec(NamedTuple):
     quant_levels: int = 0
     # monotone constraint method (monotone_constraints_method):
     # 0 = basic (children bounded at the split midpoint, inherited);
-    # 1 = intermediate/advanced (monotone_constraints.hpp:516): per-leaf
-    # bounds recomputed every split from the OPPOSITE subtrees' actual
-    # output extrema via an ancestry matrix, and every leaf's cached
-    # best split re-searched under the new bounds — less conservative
-    # than basic, still violation-free by induction. The reference's
-    # `advanced` per-threshold refinement (:858) is approximated by the
-    # same leaf-level bounds (documented deviation). Supported by both
-    # the sequential permuted grower (per-split recompute) and the
-    # rounds grower (per-round recompute + same-round conflict guard,
-    # rounds.py).
+    # 1 = intermediate (monotone_constraints.hpp:516): per-leaf bounds
+    # recomputed every split from the OPPOSITE subtrees' actual output
+    # extrema via an ancestry matrix, and every leaf's cached best
+    # split re-searched under the new bounds — less conservative than
+    # basic, still violation-free by induction;
+    # 2 = advanced (monotone_constraints.hpp:858, rounds grower only):
+    # the opposite-subtree extrema are further refined per constrained
+    # leaf — only leaves whose per-feature bin ranges overlap the
+    # constrained leaf's in every feature but the ancestor's split
+    # feature can bound it (pairwise range-intersection tables kept in
+    # the round state; strictly no looser than intermediate).
+    # Intermediate runs on both the sequential permuted grower
+    # (per-split recompute) and the rounds grower (per-round recompute
+    # + same-round conflict guard, rounds.py).
     mono_mode: int = 0
     # dataset has at least one categorical feature: rounds-mode partition
     # updates need the per-row category-set test only then; all-numerical
@@ -378,6 +382,7 @@ def grow_tree(
             bins_fm, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
             feat_mask, params, spec, valid, bundle, gh_scale,
             rng_key=rng_key, group_mat=group_mat, cegb=cegb,
+            forced=forced,
         )
     if spec.partition == "permuted":
         from .permuted import grow_tree_permuted

@@ -83,11 +83,16 @@ class _Extras(NamedTuple):
 
 def segment_caps(n_rows: int) -> tuple:
     """Static ladder of segment capacities: N, N/2, ..., >= HIST_BLK,
-    all HIST_BLK multiples (n_rows itself must already be one)."""
+    all HIST_BLK multiples when n_rows itself is one. A non-multiple
+    n_rows (per-SHARD rows on a mesh whose count doesn't divide into
+    HIST_BLK blocks) clamps the top cap to n_rows instead of rounding
+    past the operand — the pallas kernel path needs multiples, but
+    such a shard is already on the einsum fallback."""
     caps = []
     c = n_rows
     while c >= HIST_BLK:
-        caps.append(((c + HIST_BLK - 1) // HIST_BLK) * HIST_BLK)
+        caps.append(min(((c + HIST_BLK - 1) // HIST_BLK) * HIST_BLK,
+                        n_rows))
         c //= 2
     if not caps:
         caps.append(n_rows)
@@ -189,11 +194,20 @@ def grow_tree_permuted(
     ax = spec.axis_name
     caps = segment_caps(N)
     Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
+    # This grower is the reference-exact parity ORACLE
+    # (tpu_growth_mode=exact); production configs — including voting
+    # and forced splits, ISSUE 14 — route to the rounds grower
+    # (boosting.py mode resolution). The oracle keeps its narrower
+    # capability matrix:
     if spec.voting_k and spec.n_forced:
-        # forced splits read s.hist[fl] at the prescribed feature without
-        # a hist_valid gate; under voting non-elected columns hold stale
-        # per-shard values (ADVICE r3) — callers must disable one of them
-        raise ValueError("voting_k excludes forced splits (hist_valid)")
+        # the oracle's forced path reads s.hist[fl] at the prescribed
+        # feature without pinning it into the election; the rounds
+        # grower supports the combination (forced columns pinned into
+        # every election, rounds.py vote_reduce)
+        raise ValueError(
+            "voting_k excludes forced splits on the sequential oracle; "
+            "use tpu_growth_mode=rounds for the combination"
+        )
     per_node = spec.extra_trees or spec.ff_bynode or spec.cegb or spec.n_groups
     if spec.rounds and (per_node or spec.n_forced):
         raise ValueError("tpu_growth_rounds excludes per-node extras")

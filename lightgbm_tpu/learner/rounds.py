@@ -29,6 +29,15 @@ reference-exact sequential grower; this mode is the default on TPU
 hardware where the round batching is worth ~an order of magnitude
 (config.h has no analog — the reference CUDA learner batches histogram
 construction but still splits one leaf at a time).
+
+This grower is the single production path (ISSUE 14): voting-parallel
+(PV-Tree election, only elected bundle columns cross the mesh — one
+election per ROUND covering all slots jointly), forced splits (one
+prescribed split per round during the forced phase so Tree::Split leaf
+numbering matches the BFS plan), and all three monotone methods (basic
+/ intermediate / advanced) ride it; the permuted sequential grower
+remains only as the reference-exact parity oracle behind
+`tpu_growth_mode=exact`.
 """
 
 from __future__ import annotations
@@ -62,7 +71,16 @@ from .grower import (
     monotone_child_intervals,
     split_leaf_outputs,
 )
-from .split import NEG_INF, BIG, SplitParams, SplitRecord, best_split, leaf_output
+from .split import (
+    NEG_INF,
+    BIG,
+    SplitParams,
+    SplitRecord,
+    best_split,
+    feature_best_gains,
+    leaf_gain,
+    leaf_output,
+)
 
 
 class _NState(NamedTuple):
@@ -89,6 +107,21 @@ class _NState(NamedTuple):
     leaf_groups: jax.Array  # (L, NG | 0) bool — legal constraint groups
     path_used: jax.Array  # (L, F | 0) bool — features on the leaf's path
     feat_used: jax.Array  # (F | 0,) bool — used anywhere (CEGB coupled)
+    # voting-parallel: hist_valid[leaf, f] = the stored histogram column
+    # holds GLOBAL (mesh-reduced) sums for feature f — all-True except
+    # under voting, where only elected columns cross the mesh. Child
+    # search and parent subtraction are masked to valid columns
+    # (permuted.py hist_valid, lifted onto the round-batched state).
+    # Zero-width when voting is off.
+    hist_valid: jax.Array  # (L, F | 0) bool
+    # advanced monotone constraints: per-leaf per-feature bin range
+    # (lo, hi], refined at each numeric split (left keeps hi=min(hi,
+    # bin); right lo=max(lo, bin)). Two leaves can form a violating
+    # monotone pair through ancestor a only if their ranges intersect
+    # in every feature EXCEPT a's split feature. Zero-width unless
+    # mono_mode == 2.
+    leaf_flo: jax.Array  # (L, F | 0) int32
+    leaf_fhi: jax.Array  # (L, F | 0) int32
     best: SplitRecord  # per-leaf best splits, fields (L,)
     tree: TreeArrays
 
@@ -112,6 +145,9 @@ def grow_tree_rounds(
     rng_key: Optional[jax.Array] = None,  # extra_trees / ff_bynode draws
     group_mat: Optional[jax.Array] = None,  # (NG, F) bool — interaction
     cegb=None,  # CegbInfo penalty tables
+    forced=None,  # ForcedSplits plan (permuted.ForcedSplits) when
+    # spec.n_forced > 0: (leaf, feature, bin) per step, leaf ids
+    # precomputed under Tree::Split numbering
     with_stats: bool = False,  # also return per-width round counters
 ):
     """Grow one tree; returns (tree arrays, natural-order row->leaf),
@@ -130,12 +166,11 @@ def grow_tree_rounds(
     S = min(spec.rounds_slots, max(L - 1, 1))  # top_k needs k <= L
     ax = spec.axis_name
     Bc = spec.col_bins if (spec.efb and spec.col_bins) else B
-    if spec.voting_k:
-        raise ValueError("voting rides the permuted sequential grower")
-    if spec.n_forced:
-        raise ValueError("forced splits ride the permuted grower")
-    if spec.quant and gh_scale is None:
-        raise ValueError("spec.quant requires gh_scale (level scales)")
+    # voting-parallel on the rounds path (ISSUE 14): the per-round
+    # election below replaces the full-histogram mesh reduce; only
+    # elected bundle columns cross the mesh. Single-host (ax is None)
+    # voting degenerates to the plain path — there is no wire to save.
+    use_voting = bool(spec.voting_k and ax is not None)
     # per-node extras (VERDICT r4 item 4: extra_trees, ff_bynode, CEGB,
     # interaction constraints used to fall off the fast path onto the
     # ~30x-slower sequential permuted grower)
@@ -146,6 +181,15 @@ def grow_tree_rounds(
             "monotone intermediate/advanced excludes per-node extras "
             "(boosting downgrades the combination to method=basic)"
         )
+    if spec.mono_mode and (spec.voting_k or spec.n_forced):
+        raise ValueError(
+            "monotone intermediate/advanced excludes voting / forced "
+            "splits (boosting downgrades the combination to method=basic)"
+        )
+    if spec.n_forced and forced is None:
+        raise ValueError("spec.n_forced requires the forced= split plan")
+    if spec.quant and gh_scale is None:
+        raise ValueError("spec.quant requires gh_scale (level scales)")
     if per_node and (spec.extra_trees or spec.ff_bynode) \
             and rng_key is None:
         raise ValueError("extra_trees / ff_bynode need rng_key")
@@ -185,8 +229,23 @@ def grow_tree_rounds(
         ax is not None and n_rs > 1 and spec.quant
         and not spec.efb and not spec.has_cat and not spec.cat_subset
         and not spec.mono_mode and not per_node
+        # voting ships a NARROWER payload than reduce-scatter (2k
+        # elected columns vs G/n owned); forced splits read arbitrary
+        # feature columns of arbitrary leaves and need full-width
+        # per-leaf histogram pools, not owned blocks
+        and not spec.voting_k and not spec.n_forced
         and rs_exact_ok(N, n_rs, spec.quant_levels)
     )
+    if use_voting:
+        kG = min(spec.voting_k, G)
+        k2 = min(2 * spec.voting_k, G)
+        # narrowest exact integer wire for the elected-column psum:
+        # partial sums en route can only shrink below the worst-case
+        # global bound rs_wire_dtype checks, so the same policy applies
+        vote_dt = (
+            rs_wire_dtype(N, max(n_rs, 1), spec.quant_levels)
+            if spec.quant else None
+        )
     if use_rs:
         Gp = -(-G // n_rs) * n_rs  # feature axis padded to the mesh
         Gn = Gp // n_rs  # features owned per rank
@@ -369,6 +428,14 @@ def grow_tree_rounds(
         budget0 = (L - 1) - s.i
         n_pos = jnp.sum(s.best.gain > 0.0).astype(jnp.int32)
         n_cand = jnp.minimum(budget0, n_pos)
+        if spec.n_forced:
+            # forced phase: ONE split per round so Tree::Split leaf
+            # numbering matches the BFS plan's precomputed ids (the
+            # plan was laid out for sequential growth); n_pos can be 0
+            # here — the forced split doesn't need positive gain
+            n_cand = jnp.where(
+                s.i < forced.n, jnp.int32(1), n_cand
+            )
         if tail_exact:
             n_cand = jnp.minimum(n_cand, jnp.maximum((budget0 + 1) // 2, 1))
         bidx = jnp.sum(
@@ -394,7 +461,57 @@ def grow_tree_rounds(
         cap = jnp.minimum(budget, S)
         if n_max is not None:
             cap = jnp.minimum(cap, n_max)  # budget-aware tail (above)
-        topv, topl = lax.top_k(s.best.gain, S)
+        rec = s.best  # per-leaf records, fields (L,)
+        gain_sel = s.best.gain
+        if spec.n_forced:
+            # ---- forced splits (ForceSplits, serial_tree_learner
+            # .cpp:627) on the round-batched grower: while i < forced.n
+            # the round splits exactly ONE prescribed leaf at the
+            # prescribed (feature, threshold-bin) — body() caps the
+            # round budget at 1 during the forced phase so Tree::Split
+            # leaf numbering matches the plan's precomputed ids. The
+            # per-leaf best record is overwritten at the forced leaf and
+            # its selection gain raised to BIG so top_k picks it first;
+            # invalid entries (empty child / exhausted plan) fall back
+            # to the best-gain split, same documented deviation as the
+            # permuted oracle (later entries keep PRE-COMPUTED leaf ids)
+            fi = jnp.minimum(i, spec.n_forced - 1)
+            fl = forced.leaf[fi]
+            ff = forced.feature[fi]
+            fb = forced.bin[fi]
+            fh = exp_hist(s.hist[fl], s.leaf_g[fl], s.leaf_h[fl],
+                          s.leaf_c[fl])
+            cg_f = jnp.cumsum(fh[0, ff])
+            chs_f = jnp.cumsum(fh[1, ff])
+            cc_f = jnp.cumsum(fh[2, ff])
+            flg, flh, flc = cg_f[fb], chs_f[fb], cc_f[fb]
+            fpg, fph, fpn = s.leaf_g[fl], s.leaf_h[fl], s.leaf_c[fl]
+            gain_f = (
+                leaf_gain(flg, flh, params)
+                + leaf_gain(fpg - flg, fph - flh, params)
+                - leaf_gain(fpg, fph, params)
+            )
+            use_f = (i < forced.n) & (flc > 0) & (fpn - flc > 0)
+
+            def put(a, v):
+                return jnp.where(use_f, a.at[fl].set(v), a)
+
+            rec = SplitRecord(
+                gain=put(rec.gain, gain_f),
+                feature=put(rec.feature, ff),
+                bin=put(rec.bin, fb),
+                default_left=put(rec.default_left, False),
+                is_cat=put(rec.is_cat, False),
+                cat_mask=put(rec.cat_mask, jnp.zeros(B, bool)),
+                left_g=put(rec.left_g, flg),
+                left_h=put(rec.left_h, flh),
+                left_c=put(rec.left_c, flc),
+                right_g=put(rec.right_g, fpg - flg),
+                right_h=put(rec.right_h, fph - flh),
+                right_c=put(rec.right_c, fpn - flc),
+            )
+            gain_sel = put(gain_sel, BIG)
+        topv, topl = lax.top_k(gain_sel, S)
         take = (iota_S < cap) & (topv > 0.0)
         if spec.mono_mode:
             # ---- same-round conflict guard (intermediate constraints):
@@ -433,8 +550,6 @@ def grow_tree_rounds(
         new_id = i + 1 + rank
         drop_node = jnp.where(sel, node_id, L - 1)  # L-1 -> mode=drop
         drop_new = jnp.where(sel, new_id, L)
-
-        rec = s.best  # per-leaf records, fields (L,)
 
         # ---- outputs / monotone intervals, vectorized over leaves ----
         pmin, pmax = s.leaf_min, s.leaf_max
@@ -511,6 +626,88 @@ def grow_tree_rounds(
         nan_s = nan_bin[feat_s]
         new_id_s = jnp.where(take, i + 1 + rank_s, L)
 
+        def vote_reduce(sh):
+            # ---- GlobalVoting election (parallel_tree_learner.h:152 /
+            # voting_parallel_tree_learner.cpp), per ROUND: each shard
+            # proposes its top-k columns by LOCAL gain over this round's
+            # smaller children (max over live slots), votes + summed
+            # gains elect 2k columns, and ONLY those columns cross the
+            # mesh (gather-by-index psum, int16/int32 payload when the
+            # quantized sums are exact — histogram.rs_wire_dtype). The
+            # election unit is the bundle column, so voting composes
+            # with EFB. Unlike the permuted oracle's per-SPLIT election
+            # this elects once per round for all slots jointly — the
+            # same PV-Tree approximation at one wire round per
+            # histogram pass (documented deviation; parity tests pin
+            # the saturated-election case where both coincide).
+            local = sh * scale3[:, None, None] if spec.quant else sh
+            # per-slot (g, h, count) totals from column 0's bin sums:
+            # bins_fm is dense, so every device column partitions the
+            # slot's rows
+            lsum = jnp.sum(local[:, :, 0, :], axis=-1)  # (S, 3)
+
+            def slot_gains(h, g_, h__, c_):
+                return feature_best_gains(
+                    exp_hist(h, g_, h__, c_), g_, h__, c_, num_bins,
+                    nan_bin, mono, is_cat, params, feat_mask,
+                    cat_subset=spec.cat_subset,
+                )
+
+            lg_s = jax.vmap(slot_gains)(
+                local, lsum[:, 0], lsum[:, 1], lsum[:, 2]
+            )  # (S, F) local per-feature gains
+            lg_s = jnp.where(take[:, None], lg_s, NEG_INF)  # dead slots
+            fgain = jnp.max(lg_s, axis=0)  # (F,) best over live slots
+            if spec.efb:
+                col_gain = jnp.full(G, NEG_INF).at[bundle.bundle_of].max(
+                    fgain
+                )
+            else:
+                col_gain = fgain
+            _, topi = lax.top_k(col_gain, kG)
+            in_topk = jnp.zeros(G, bool).at[topi].set(True)
+            votes = lax.psum(in_topk.astype(jnp.float32), ax)
+            score = lax.psum(
+                jnp.where(in_topk, jnp.maximum(col_gain, 0.0), 0.0), ax
+            )
+            _, eidx = lax.top_k(votes * 1e12 + score, k2)
+            if spec.n_forced:
+                # pin the forced plan's columns into every election:
+                # forced splits read their prescribed feature's column
+                # unconditionally, so it must always carry global sums
+                # (this lifts the old voting_k-excludes-forced guard;
+                # duplicate indices scatter identical psum'd slices)
+                fcols = (bundle.bundle_of[forced.feature] if spec.efb
+                         else forced.feature)
+                eidx = jnp.concatenate([eidx, fcols])
+            elected_cols = jnp.zeros(G, bool).at[eidx].set(True)
+            payload = sh[:, :, eidx, :]  # (S, 3, 2k[+n_forced], Bc)
+            if vote_dt is not None:
+                comp = lax.psum(payload.astype(vote_dt), ax).astype(
+                    jnp.float32
+                )
+            else:
+                comp = lax.psum(payload, ax)
+            sh = jnp.zeros_like(sh).at[:, :, eidx, :].set(comp)
+            el = elected_cols[bundle.bundle_of] if spec.efb else elected_cols
+            return sh, el  # el: (F,) feature-space elected mask
+
+        def reduce_slots(sh):
+            """Mesh reduce of the (S, 3, G|Gn, Bc) local slot histograms
+            — elected-columns-only under voting, reduce-scatter or psum
+            otherwise — then the dequantization scale. Returns the
+            reduced hists and the elected (F,) mask (None off voting)."""
+            el = None
+            if use_voting:
+                sh, el = vote_reduce(sh)
+            elif use_rs:
+                sh = rs_hist(sh)  # int wire, owned block
+            elif ax is not None:
+                sh = lax.psum(sh, ax)
+            if spec.quant:
+                sh = sh * scale3[:, None, None]
+            return sh, el
+
         if use_fused:
             zs = jnp.zeros(S, jnp.int32)
             if spec.efb:
@@ -545,12 +742,7 @@ def grow_tree_rounds(
                 quant=spec.quant, int8=use_int8, oh_shift=oh_shift,
                 efb=spec.efb, cat_mask=cm_s,
             )
-            if use_rs:
-                slot_hists = rs_hist(slot_hists)  # int32 wire, owned block
-            elif ax is not None:
-                slot_hists = lax.psum(slot_hists, ax)
-            if spec.quant:
-                slot_hists = slot_hists * scale3[:, None, None]
+            slot_hists, elected = reduce_slots(slot_hists)
         else:
             pack_cols = [
                 col_s.astype(jnp.float32),  # 0: device bin column
@@ -625,12 +817,7 @@ def grow_tree_rounds(
                 bins_fm, gh8, hslot, S, Bc, quant=spec.quant,
                 int8=use_int8, oh_shift=oh_shift,
             )  # (S, 3, G, Bc)
-            if use_rs:
-                slot_hists = rs_hist(slot_hists)  # int32 wire, owned block
-            elif ax is not None:
-                slot_hists = lax.psum(slot_hists, ax)
-            if spec.quant:
-                slot_hists = slot_hists * scale3[:, None, None]
+            slot_hists, elected = reduce_slots(slot_hists)
 
         # ---- per-slot child hists: smaller from the pass, larger by
         # subtraction; scatter both into the pool. Work stays O(S), not
@@ -643,6 +830,25 @@ def grow_tree_rounds(
         right_s = jnp.where(ls_s, large_s, slot_hists)
         hist = s.hist.at[sel_leaf].set(left_s, mode="drop")
         hist = hist.at[new_id_s].set(right_s, mode="drop")
+
+        hist_valid2 = s.hist_valid
+        if use_voting:
+            # the smaller child's histogram holds global sums exactly at
+            # the elected columns; the larger sibling's subtraction is
+            # additionally only sound where the PARENT's stored column
+            # was global (permuted.py valid_small / valid_large)
+            valid_parent_s = s.hist_valid[sl_c]  # (S, F)
+            valid_small = jnp.broadcast_to(
+                elected[None, :], valid_parent_s.shape
+            )
+            valid_large = valid_small & valid_parent_s
+            ls_v = left_smaller[sl_c][:, None]
+            valid_left = jnp.where(ls_v, valid_small, valid_large)
+            valid_right = jnp.where(ls_v, valid_large, valid_small)
+            hist_valid2 = (
+                s.hist_valid.at[sel_leaf].set(valid_left, mode="drop")
+                .at[new_id_s].set(valid_right, mode="drop")
+            )
 
         # ---- best splits for the new children, batched over 2S ----
         def child_best(h, g_, h__, c_, po, cmn, cmx, fm=None, rb=None,
@@ -664,6 +870,7 @@ def grow_tree_rounds(
             .at[drop_new].set(rec.right_c, mode="drop")
 
         anc_in2, anc_left2 = s.anc_in, s.anc_left
+        flo2, fhi2 = s.leaf_flo, s.leaf_fhi
         lg2, pu2, fu2 = s.leaf_groups, s.path_used, s.feat_used
         if not spec.mono_mode:
             ch_hist = jnp.concatenate([left_s, right_s])  # (2S, 3, G, Bc)
@@ -673,6 +880,10 @@ def grow_tree_rounds(
             ch_po = jnp.concatenate([lo[sl_c], ro[sl_c]])
             ch_mn = jnp.concatenate([lmin[sl_c], rmin[sl_c]])
             ch_mx = jnp.concatenate([lmax[sl_c], rmax[sl_c]])
+            if use_voting:
+                # only columns whose stored sums are global may be
+                # searched — unelected columns hold local/garbage sums
+                ch_valid = jnp.concatenate([valid_left, valid_right])
             if per_node:
                 # per-node candidate machinery for this round's 2S
                 # children (permuted.py node_candidates semantics)
@@ -694,6 +905,8 @@ def grow_tree_rounds(
                 ch_fm, ch_rb, ch_pen = jax.vmap(
                     node_candidates, in_axes=(0, 0, 0, 0, None)
                 )(salts, cg2, puc2, ch_c, fu2)
+                if use_voting:
+                    ch_fm = ch_fm & ch_valid
                 ch_rec = jax.vmap(child_best)(
                     ch_hist, ch_g, ch_h, ch_c, ch_po, ch_mn, ch_mx,
                     ch_fm, ch_rb, ch_pen,
@@ -704,6 +917,11 @@ def grow_tree_rounds(
                 pu2 = s.path_used.at[sel_leaf].set(
                     pu_child, mode="drop"
                 ).at[new_id_s].set(pu_child, mode="drop")
+            elif use_voting:
+                ch_rec = jax.vmap(child_best)(
+                    ch_hist, ch_g, ch_h, ch_c, ch_po, ch_mn, ch_mx,
+                    feat_mask[None, :] & ch_valid,
+                )
             else:
                 ch_rec = jax.vmap(child_best)(
                     ch_hist, ch_g, ch_h, ch_c, ch_po, ch_mn, ch_mx
@@ -773,16 +991,95 @@ def grow_tree_rounds(
             node_alive = jnp.arange(L - 1, dtype=jnp.int32) < i_new
             in_l = anc_in2 & anc_left2 & valid_leaf[:, None]
             in_r = anc_in2 & ~anc_left2 & valid_leaf[:, None]
-            Lmax = jnp.max(jnp.where(in_l, leaf_out2[:, None], -BIG), axis=0)
-            Lmin = jnp.min(jnp.where(in_l, leaf_out2[:, None], BIG), axis=0)
-            Rmax = jnp.max(jnp.where(in_r, leaf_out2[:, None], -BIG), axis=0)
-            Rmin = jnp.min(jnp.where(in_r, leaf_out2[:, None], BIG), axis=0)
+            if spec.mono_mode == 2:
+                # ---- advanced constraints (monotone_constraints
+                # .hpp:858 AdvancedLeafConstraints): the opposite-
+                # subtree extremum bounding leaf x through monotone
+                # ancestor a is taken only over leaves r whose feature-
+                # domain can actually meet x's — i.e. their bin ranges
+                # intersect in every feature EXCEPT a's split feature
+                # (x and r always differ there; a violating pair needs
+                # a point equal in all other features, and two leaves
+                # whose (lo, hi] bin intervals are disjoint in some
+                # other feature admit no such point). Bin-interval
+                # overlap over-approximates value equality, so the
+                # refinement never drops a needed constraint; it is
+                # strictly no looser than the intermediate broadcast.
+                # 1. refine per-(leaf, feature) ranges with this
+                # round's splits: numeric splits shrink the split
+                # feature's interval (left hi=min(hi, bin); right
+                # lo=max(lo, bin)); categorical splits and features
+                # with a NaN bin keep the full range — their rows
+                # don't partition by bin interval (conservative).
+                refine = sel & ~rec.is_cat & (nan_bin[rec.feature] < 0)
+                f_oh = (
+                    jnp.arange(F, dtype=jnp.int32)[None, :]
+                    == rec.feature[:, None]
+                ) & refine[:, None]  # (L, F)
+                hi_l = jnp.where(
+                    f_oh, jnp.minimum(s.leaf_fhi, rec.bin[:, None]),
+                    s.leaf_fhi,
+                )
+                lo_r = jnp.where(
+                    f_oh, jnp.maximum(s.leaf_flo, rec.bin[:, None]),
+                    s.leaf_flo,
+                )
+                # left child keeps the parent id in place; right child
+                # scatters the parent's pre-round row, lo raised
+                flo2 = s.leaf_flo.at[new_id_s].set(
+                    lo_r[sl_c], mode="drop")
+                fhi2 = jnp.where(sel[:, None], hi_l, s.leaf_fhi).at[
+                    new_id_s].set(s.leaf_fhi[sl_c], mode="drop")
+                # 2. pairwise per-feature (lo, hi] intersection and the
+                # per-ancestor comparability mask ok_pair[x, r, a]:
+                # ranges overlap everywhere except possibly on a's
+                # split feature
+                ivf = (
+                    jnp.maximum(flo2[:, None, :], flo2[None, :, :])
+                    < jnp.minimum(fhi2[:, None, :], fhi2[None, :, :])
+                )  # (L, L, F)
+                n_bad = jnp.sum(~ivf, axis=2)  # (L, L)
+                bad_fa = ~jnp.take(
+                    ivf,
+                    jnp.minimum(tree_new.node_feature, F - 1),
+                    axis=2,
+                )  # (L, L, L-1) — disjoint on node a's split feature?
+                ok_pair = (
+                    n_bad[:, :, None] - bad_fa.astype(jnp.int32)
+                ) <= 0
+                # 3. per-(x, a) refined opposite-subtree extrema
+                # replacing the intermediate method's broadcast rows
+
+                def _ext(in_m, red, init):
+                    sel_m = in_m[None, :, :] & ok_pair  # (L, L, L-1)
+                    return red(
+                        jnp.where(sel_m, leaf_out2[None, :, None], init),
+                        axis=1,
+                    )  # (L, L-1)
+
+                Lmax = _ext(in_l, jnp.max, -BIG)
+                Lmin = _ext(in_l, jnp.min, BIG)
+                Rmax = _ext(in_r, jnp.max, -BIG)
+                Rmin = _ext(in_r, jnp.min, BIG)
+            else:
+                Lmax = jnp.max(
+                    jnp.where(in_l, leaf_out2[:, None], -BIG), axis=0
+                )[None, :]
+                Lmin = jnp.min(
+                    jnp.where(in_l, leaf_out2[:, None], BIG), axis=0
+                )[None, :]
+                Rmax = jnp.max(
+                    jnp.where(in_r, leaf_out2[:, None], -BIG), axis=0
+                )[None, :]
+                Rmin = jnp.min(
+                    jnp.where(in_r, leaf_out2[:, None], BIG), axis=0
+                )[None, :]
             inc = (node_alive & (node_m > 0))[None, :]
             dec = (node_alive & (node_m < 0))[None, :]
-            cmax_mat = jnp.where(in_l & inc, Rmin[None, :], BIG)
-            cmax_mat = jnp.where(in_r & dec, Lmin[None, :], cmax_mat)
-            cmin_mat = jnp.where(in_r & inc, Lmax[None, :], -BIG)
-            cmin_mat = jnp.where(in_l & dec, Rmax[None, :], cmin_mat)
+            cmax_mat = jnp.where(in_l & inc, Rmin, BIG)
+            cmax_mat = jnp.where(in_r & dec, Lmin, cmax_mat)
+            cmin_mat = jnp.where(in_r & inc, Lmax, -BIG)
+            cmin_mat = jnp.where(in_l & dec, Rmax, cmin_mat)
             nmax = jnp.min(cmax_mat, axis=1)  # (L,)
             nmin = jnp.max(cmin_mat, axis=1)
 
@@ -812,12 +1109,31 @@ def grow_tree_rounds(
             leaf_groups=lg2,
             path_used=pu2,
             feat_used=fu2,
+            hist_valid=hist_valid2,
+            leaf_flo=flo2,
+            leaf_fhi=fhi2,
             best=best2,
             tree=tree_new,
         )
 
+    def _forced_valid(s: _NState):
+        """Is step s.i a forced split with both children non-empty?"""
+        fi = jnp.minimum(s.i, spec.n_forced - 1)
+        fl = forced.leaf[fi]
+        ff = forced.feature[fi]
+        fb = forced.bin[fi]
+        fh = exp_hist(s.hist[fl], s.leaf_g[fl], s.leaf_h[fl], s.leaf_c[fl])
+        lc = jnp.cumsum(fh[2, ff])[fb]
+        return (s.i < forced.n) & (lc > 0) & (s.leaf_c[fl] - lc > 0)
+
     def cond(s: _NState) -> jax.Array:
-        return (s.i < L - 1) & (jnp.max(s.best.gain) > 0.0)
+        keep = jnp.max(s.best.gain) > 0.0
+        if spec.n_forced:
+            # only continue for a forced step that can actually split
+            # (both children non-empty) — the round body falls back to
+            # the best-gain split otherwise, which `keep` already guards
+            keep = keep | _forced_valid(s)
+        return (s.i < L - 1) & keep
 
     state = _NState(
         i=jnp.int32(0),
@@ -835,6 +1151,15 @@ def grow_tree_rounds(
         leaf_groups=lg0,
         path_used=pu0,
         feat_used=fu0,
+        # root histogram always crosses the mesh in full, so every
+        # column starts globally valid
+        hist_valid=jnp.ones((L, F if use_voting else 0), bool),
+        leaf_flo=jnp.full(
+            (L, F if spec.mono_mode == 2 else 0), -1, jnp.int32
+        ),
+        leaf_fhi=jnp.full(
+            (L, F if spec.mono_mode == 2 else 0), B, jnp.int32
+        ),
         best=best,
         tree=tree,
     )

@@ -149,6 +149,22 @@ def build_manifest(config: Optional[Any] = None,
                 "hist_dtype": getattr(
                     getattr(booster, "_gbdt", None), "hist_dtype", None
                 ),
+                # RESOLVED tree learner after mode resolution plus the
+                # voting election footprint (elected columns and the
+                # per-tree wire estimate) — distinguishes the
+                # elected-columns-only reduce from a full-histogram run
+                "tree_learner": getattr(
+                    getattr(booster, "_gbdt", None),
+                    "tree_learner_resolved", None
+                ),
+                "voting_elected_cols": getattr(
+                    getattr(booster, "_gbdt", None),
+                    "voting_elected_cols", None
+                ),
+                "voting_wire_bytes_est": getattr(
+                    getattr(booster, "_gbdt", None),
+                    "voting_wire_bytes_est", None
+                ),
             }
         except Exception:  # noqa: BLE001 — model summary is best-effort
             pass

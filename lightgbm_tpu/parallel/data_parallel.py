@@ -12,7 +12,10 @@ smaller-child histogram; everything downstream is computed redundantly
 (and identically) on every shard, so trees stay in lockstep without any
 split broadcast — the same invariant the reference relies on
 (SURVEY §3.3). The psum payload per split is one (3, F, B) f32 histogram,
-matching the reference's wire payload of histogram pairs.
+matching the reference's wire payload of histogram pairs. Under
+tree_learner=voting the per-round election (rounds.py vote_reduce) cuts
+that payload to the elected ~2k columns, in int16 when the quantized
+sums provably fit.
 """
 
 from __future__ import annotations
@@ -71,6 +74,7 @@ class DataParallelGrower:
         s = self.spec
         if (n > 1 and s.quant and not s.efb and not s.has_cat
                 and not s.cat_subset and not s.mono_mode
+                and not s.voting_k and not s.n_forced
                 and not (s.extra_trees or s.ff_bynode or s.cegb
                          or s.n_groups)):
             from .. import log
@@ -143,8 +147,19 @@ class DataParallelGrower:
         F = int(num_features)
         est = self._wire_est.get(F)
         if est is None:
-            per_split = 3 * F * int(self.spec.num_bins) * 4
-            est = per_split * int(self.spec.num_leaves)
+            s = self.spec
+            cols = F
+            if s.voting_k:
+                # voting-parallel: only the elected columns (2k, plus
+                # any pinned forced-plan columns) cross the mesh per
+                # round (rounds.py vote_reduce). 4-byte lanes is the
+                # conservative bound — the quantized election wire may
+                # ride int16 (histogram.rs_wire_dtype, decided from the
+                # traced row count); the exact per-config payload is
+                # pinned statically in analysis cost_budget.json.
+                cols = min(2 * int(s.voting_k) + int(s.n_forced), F)
+            per_split = 3 * cols * int(s.num_bins) * 4
+            est = per_split * int(s.num_leaves)
             self._wire_est[F] = est
         return est
 

@@ -170,7 +170,10 @@ def test_unimplemented_params_warn(capsys):
 def test_monotone_methods_violation_scan(method, direction):
     """Deep-tree violation scan for all three constraint methods
     (monotone_constraints.hpp basic:489, intermediate:516,
-    advanced:858 — advanced maps onto the intermediate formulation)."""
+    advanced:858). On this default (exact-oracle) path advanced
+    downgrades to the intermediate formulation with a warning — the
+    true advanced refinement rides the rounds grower
+    (test_monotone_rounds_mode_violation_scan)."""
     rs = np.random.RandomState(5)
     n = 4000
     X = rs.randn(n, 4)
@@ -187,14 +190,17 @@ def test_monotone_methods_violation_scan(method, direction):
     _check_monotone(bst, X, 0, direction)
 
 
-@pytest.mark.parametrize("method", ["basic", "intermediate"])
+@pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
 @pytest.mark.parametrize("direction", [1, -1])
 def test_monotone_rounds_mode_violation_scan(method, direction):
-    """Monotone constraints on the TPU fast path (VERDICT r4 item 3):
-    the round-batched grower enforces basic via inherited intervals and
-    intermediate via the per-round ancestry-bounds recompute with the
-    same-round opposite-subtree conflict guard — deep trees grown in
-    rounds mode must hold the constraint globally."""
+    """Monotone constraints on the TPU fast path (VERDICT r4 item 3 +
+    ISSUE 14): the round-batched grower enforces basic via inherited
+    intervals, intermediate via the per-round ancestry-bounds recompute
+    with the same-round opposite-subtree conflict guard, and advanced
+    via the per-leaf bin-range overlap refinement of the
+    opposite-subtree extrema (monotone_constraints.hpp:858) — deep
+    trees grown in rounds mode must hold the constraint globally under
+    all three."""
     rs = np.random.RandomState(5)
     n = 4000
     X = rs.randn(n, 4)
@@ -210,6 +216,27 @@ def test_monotone_rounds_mode_violation_scan(method, direction):
         ds, num_boost_round=10,
     )
     _check_monotone(bst, X, 0, direction)
+
+
+def test_monotone_advanced_mode_resolution():
+    """method=advanced resolves to mono_mode=2 on the rounds path and
+    downgrades to the intermediate formulation (mono_mode=1, with a
+    warning) on the exact oracle, which only implements intermediate."""
+    rs = np.random.RandomState(3)
+    X = rs.randn(1500, 3)
+    y = 1.1 * X[:, 0] + 0.5 * X[:, 1] + 0.2 * rs.randn(1500)
+    modes = {}
+    for mode in ("rounds", "exact"):
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst = lgb.train(
+            {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+             "monotone_constraints": [1, 0, 0],
+             "monotone_constraints_method": "advanced",
+             "tpu_growth_mode": mode},
+            ds, num_boost_round=2,
+        )
+        modes[mode] = int(bst._gbdt.spec.mono_mode)
+    assert modes == {"rounds": 2, "exact": 1}
 
 
 def test_monotone_rounds_quality_close_to_exact():

@@ -191,6 +191,72 @@ def test_hist_slots_matches_masked():
         )
 
 
+def test_rounds_forced_splits_match_exact(tmp_path):
+    """forcedsplits_filename on the rounds grower (ISSUE 14): the
+    forced phase applies exactly one plan split per round (so
+    Tree::Split leaf numbering matches the BFS plan), then best-gain
+    growth resumes. With a non-binding leaf budget both growers are
+    greedy past the forced prefix, so the full model must match the
+    sequential exact oracle."""
+    import json as _json
+
+    rs = np.random.RandomState(7)
+    X = rs.randn(4000, 6)
+    y = (1.2 * X[:, 0] + X[:, 1] ** 2 + 0.3 * rs.randn(4000) > 0.8
+         ).astype(float)
+    p = tmp_path / "forced.json"
+    p.write_text(_json.dumps({
+        "feature": 0, "threshold": 0.0,
+        "left": {"feature": 1, "threshold": 0.5},
+    }))
+    preds, models = {}, {}
+    for mode in ("exact", "rounds"):
+        params = dict(objective="binary", num_leaves=256,
+                      min_data_in_leaf=40, min_gain_to_split=0.5,
+                      verbosity=-1, tpu_growth_mode=mode,
+                      forcedsplits_filename=str(p))
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst = lgb.train(params, ds, num_boost_round=3)
+        preds[mode] = bst.predict(X)
+        models[mode] = bst._gbdt.models
+    for t in models["rounds"]:
+        assert int(t.split_feature[0]) == 0  # the forced root split
+    np.testing.assert_allclose(preds["rounds"], preds["exact"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_grower_capability_matrix_raises(small_ds):
+    """The combinations that remain genuinely unsupported after the
+    grower unification must still raise instead of silently training
+    wrong (ISSUE 14 satellite): the sequential oracle rejects
+    voting x forced, and the rounds grower rejects a forced spec with
+    no plan and monotone intermediate/advanced combined with voting or
+    forced splits."""
+    cfg = Config({"num_leaves": 8, "max_bin": 63, "min_data_in_leaf": 5})
+    params = make_split_params(cfg)
+    B = small_ds.max_num_bin
+
+    # sequential oracle: voting + forced splits
+    spec = GrowerSpec(num_leaves=8, num_bins=B, max_depth=-1,
+                      voting_k=2, n_forced=1)
+    with pytest.raises(ValueError, match="sequential oracle"):
+        _grow(small_ds, params, spec)
+
+    # rounds grower: spec.n_forced without the forced= plan
+    spec = GrowerSpec(num_leaves=8, num_bins=B, max_depth=-1,
+                      rounds_slots=4, n_forced=1)
+    with pytest.raises(ValueError, match="forced"):
+        _grow(small_ds, params, spec)
+
+    # rounds grower: monotone intermediate/advanced x voting / forced
+    for combo in (dict(voting_k=2, axis_name=None),
+                  dict(n_forced=1)):
+        spec = GrowerSpec(num_leaves=8, num_bins=B, max_depth=-1,
+                          rounds_slots=4, mono_mode=2, **combo)
+        with pytest.raises(ValueError, match="monotone"):
+            _grow(small_ds, params, spec)
+
+
 def _extras_problem(n=3000, f=8, seed=11):
     rs = np.random.RandomState(seed)
     X = rs.randn(n, f)

@@ -516,9 +516,10 @@ def test_unload_closes_microbatcher(rng):
     reg.load("m", bst.model_to_string())
     reg.predict("m", X[:10], via_queue=True)  # lazily creates the batcher
     mv = reg._entry("m")
-    assert mv.batcher is not None and mv.batcher._worker.is_alive()
+    assert mv.batcher is not None
+    assert all(w.is_alive() for w in mv.batcher._workers)
     reg.unload("m")
-    assert not mv.batcher._worker.is_alive()
+    assert not any(w.is_alive() for w in mv.batcher._workers)
 
 
 def test_serve_buckets_default_matches_dispatch():

@@ -142,6 +142,87 @@ def test_voting_parallel_trains():
     )
 
 
+def test_voting_on_rounds_matches_data_saturated():
+    """tree_learner=voting on the rounds grower (ISSUE 14): with
+    top_k >= num_features every column wins election, so the per-round
+    election is exact and predictions must match tree_learner=data on
+    the same rounds path — the 8-mesh lockstep contract. With a small
+    top_k the election restricts the search (and the wire) but the
+    model must still learn."""
+    from sklearn.metrics import roc_auc_score
+
+    X, y = _binary_problem(n=4096, f=12, seed=9)
+    r = {"tpu_growth_mode": "rounds"}
+    b_vote = _train({**BASE, **r, "tree_learner": "voting", "top_k": 12},
+                    X, y)
+    b_data = _train({**BASE, **r, "tree_learner": "data"}, X, y)
+    assert b_vote.num_trees() == b_data.num_trees()
+    np.testing.assert_allclose(
+        b_vote.predict(X), b_data.predict(X), rtol=1e-4, atol=1e-5
+    )
+
+    b_small = _train({**BASE, **r, "tree_learner": "voting", "top_k": 3},
+                     X, y)
+    assert b_small.num_trees() == 15
+    assert roc_auc_score(y, b_small.predict(X)) > 0.9
+    # provenance attrs the flight recorder / manifest read
+    g = b_small._gbdt
+    assert g.tree_learner_resolved == "voting"
+    assert g.voting_elected_cols == 6  # 2 * top_k, no forced columns
+    assert g.voting_wire_bytes_est and g.voting_wire_bytes_est > 0
+    # the elected-only estimate must undercut the all-feature payload
+    full = 3 * 12 * g.spec.num_bins * 4 * g.spec.num_leaves
+    assert g.voting_wire_bytes_est < full
+
+
+def test_voting_rounds_jaxpr_wire():
+    """The voting grower's compiled program must contain NO full-width
+    reduce-scatter: the election ships only elected columns, as an
+    int16 psum payload when the quantized sums provably fit
+    (rounds.vote_reduce + histogram.rs_wire_dtype). Asserted off the
+    jaxpr with the same walkers the static audits use."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.analysis.cost_audit import collect_wire
+    from lightgbm_tpu.analysis.jaxpr_audit import summarize
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import BinnedDataset
+    from lightgbm_tpu.learner import GrowerSpec, make_split_params
+    from lightgbm_tpu.parallel.data_parallel import (
+        DataParallelGrower,
+        make_mesh,
+    )
+
+    X, _ = _binary_problem(seed=13)
+    cfg = Config({"max_bin": 63, "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_numpy(X.astype(np.float32), cfg)
+    d = ds.device_arrays()
+    Np = ds.num_rows_padded()
+    spec = GrowerSpec(num_leaves=15, num_bins=ds.max_num_bin,
+                      max_depth=-1, rounds_slots=8, has_cat=False,
+                      quant=True, quant_levels=4, voting_k=2)
+    g = DataParallelGrower(make_mesh(), spec)
+    gq = jnp.asarray(
+        np.random.RandomState(0).randint(-2, 3, Np).astype(np.float32))
+    hq = jnp.ones(Np, jnp.float32)
+    closed = jax.make_jaxpr(lambda *a: g._fn(*a))(
+        d["bins"], d["nan_bin"], d["num_bins"], d["mono"], d["is_cat"],
+        gq, hq, d["valid"], jnp.ones(ds.num_used_features, bool),
+        make_split_params(cfg), d["valid"], None, None, None, None, None,
+        jnp.asarray(np.float32([0.1, 0.1])),
+    )
+    s = summarize(closed)
+    assert s.prim_counts.get("reduce_scatter", 0) == 0, (
+        "full-width reduce-scatter wire survived under voting"
+    )
+    assert s.prim_counts.get("psum", 0) > 0
+    wire = collect_wire(closed)
+    assert any(w.prim == "psum" and w.dtype == "int16" for w in wire), (
+        f"elected-column payload did not ride int16: {wire}"
+    )
+
+
 def test_rounds_and_efb_on_mesh():
     """Round-batched growth and EFB under shard_map: the rounds-body
     psums (global child counts, slot histograms) and the dense_visits
