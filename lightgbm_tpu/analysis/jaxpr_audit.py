@@ -482,6 +482,16 @@ def _trace_serving_contrib():
     )(tables, ctables, mk((N, F), jnp.float32), mk((T,), jnp.float32))
 
 
+def _trace_online_holdout():
+    """Online promotion gate holdout evaluator (online/gate.py):
+    auc + binary_logloss DeviceEvalSet over a 256-row shard with
+    deterministic arange-parity labels — the gate's verdict arithmetic
+    as one traced fn(score)->(m,)."""
+    from ..online.gate import trace_holdout_eval
+
+    return trace_holdout_eval(n=256, num_class=1)
+
+
 class _Entry(NamedTuple):
     builder: Callable[[], Any]
     contracts: Callable[[Optional[int]], List[ContractFn]]
@@ -652,6 +662,17 @@ ENTRIES: Dict[str, _Entry] = {
         "extend/unwind permutation-weight DP over (row, tree, leaf) "
         "lanes, host shap.py parity",
     ),
+    "online_holdout_eval": _Entry(
+        _trace_online_holdout,
+        lambda budget: [
+            no_host_callbacks(),
+            no_f64(),
+            within_budget(budget),
+        ],
+        "online promotion-gate holdout evaluator (online/gate.py): "
+        "device metrics over the candidate's raw margins — the gate "
+        "verdict must stay callback-free and f32",
+    ),
 }
 
 
@@ -744,6 +765,7 @@ def audit_faultinject() -> AuditResult:
         "serving/dispatch.py",        # host side of the device call
         "serving/server.py",          # request transport
         "serving/fleet.py",           # HBM paging (fleet_page site)
+        "online/loop.py",             # loop_* phase sites per cycle
     }
     sites: List[str] = []
     offenders: List[str] = []

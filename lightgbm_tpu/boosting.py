@@ -2461,6 +2461,39 @@ class RF(GBDT):
         self.iter_ -= 1
 
 
+def splice_continued(base: GBDT, delta: GBDT) -> GBDT:
+    """Graft a continuation's trees onto the model it warm-started from.
+
+    The online loop's init_score handoff (docs/RESILIENCE.md): the
+    candidate v(n+1) is trained as a FRESH booster over the microbatch
+    with ``init_score`` = v(n)'s raw margins, so the delta trees encode
+    only the residual on top of v(n). Raw scores add, therefore
+    ``base.models + delta.models`` scores exactly v(n+1) — no
+    ``_continue_from`` replay of every historical tree per cycle
+    (that is O(total trees); this splice is O(new trees)). Mutates and
+    returns ``base``.
+    """
+    if base.num_class != delta.num_class:
+        raise ValueError(
+            f"cannot splice: num_tree_per_iteration mismatch "
+            f"({base.num_class} vs {delta.num_class})"
+        )
+    if base.average_output or delta.average_output:
+        raise ValueError(
+            "cannot splice averaged (rf) models: predictions divide by "
+            "iteration count, so tree lists do not compose by append"
+        )
+    combined = list(base.models) + list(delta.models)
+    if len(combined) % base.num_class:
+        raise ValueError(
+            f"cannot splice: {len(combined)} trees is not a whole number "
+            f"of {base.num_class}-tree iterations"
+        )
+    base.models = combined  # setter also clears any pending device trees
+    base.iter_ = len(combined) // base.num_class
+    return base
+
+
 def create_boosting(config: Config, train_set: Optional[BinnedDataset]) -> GBDT:
     """Boosting factory (reference src/boosting/boosting.cpp:40)."""
     b = config.boosting

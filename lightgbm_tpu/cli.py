@@ -362,6 +362,111 @@ def _task_serve(params: Dict[str, str]) -> None:
          log._debug_method) = prev_logger
 
 
+def _task_loop(params: Dict[str, str]) -> None:
+    """task=loop: the online train-and-serve loop (lightgbm_tpu/online,
+    docs/RESILIENCE.md "Online loop"). Serves the promoted model on the
+    configured transport while verdict cycles refit / gate / promote
+    from microbatches spooled through the ``ingest`` op. ``valid_data=``
+    names the fixed holdout shard the gate judges on; v0 comes from
+    ``input_model=`` if it exists, else is trained from ``data=``, and
+    a loop_dir that already holds state resumes from it regardless."""
+    import threading
+
+    from .config import Config
+    from .online import OnlineLoop, state_path
+    from .resilience import faultinject
+    from .serving import ModelRegistry, ScoringServer, serve_http
+
+    t0 = time.time()
+    cfg = Config(dict(params))
+    # chaos testing: arm loop_* / serve_request sites before anything
+    faultinject.configure(cfg.fault_plan)
+
+    from .parsers import load_text_file
+
+    vpath = str(params.get("valid_data", params.get("valid", ""))
+                ).split(",")[0]
+    if not vpath:
+        log.fatal("task=loop needs valid_data= (the holdout shard the "
+                  "promotion gate judges on)")
+    loaded = load_text_file(
+        vpath,
+        header=str(params.get("header", "false")).lower() in ("true", "1"),
+        label_column=params.get("label_column", 0),
+        weight_column=params.get("weight_column", ""),
+        group_column=params.get("group_column", ""),
+        ignore_column=params.get("ignore_column", ""),
+        categorical_feature=params.get("categorical_feature", ""),
+    )
+    holdout = (loaded["X"], loaded["label"], loaded["weight"])
+
+    init_model = None
+    if not Path(state_path(cfg.loop_dir)).exists():
+        model_path = params.get("input_model", "")
+        if model_path and Path(model_path).exists():
+            init_model = model_path
+        elif params.get("data"):
+            from . import train as lgb_train
+
+            ds = _load_dataset(params, params["data"])
+            log.info(f"task=loop: training v0 from {params['data']}")
+            init_model = lgb_train(dict(params), ds,
+                                   num_boost_round=cfg.num_iterations)
+        else:
+            log.fatal("task=loop needs input_model= or data= to seed v0 "
+                      "(or an existing loop_dir to resume)")
+
+    loop = OnlineLoop(dict(params), holdout, initial_model=init_model)
+    registry = ModelRegistry(
+        buckets=cfg.serve_buckets, warmup=cfg.serve_warmup,
+        deadline_s=cfg.serve_deadline_ms / 1000.0,
+        queue_cap=cfg.serve_queue_cap, replicas=cfg.serve_replicas,
+    )
+    loop.attach(registry, cfg.serve_model_name)
+
+    if cfg.serve_port > 0:
+        httpd = serve_http(registry, cfg.serve_port, cfg.serve_host,
+                           block=False)
+        server_thread = threading.Thread(
+            target=httpd.serve_forever, name="lgb-loop-http", daemon=True)
+        server_thread.start()
+        try:
+            n = loop.run()
+            log.info(f"task=loop: {n} verdict cycle(s) complete")
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
+        return
+
+    # stdio mode: the JSONL protocol owns stdout to EOF (same logger
+    # reroute as task=serve); the loop drives from a background thread
+    # and stops when the request stream ends
+    prev_logger = (log._logger, log._info_method, log._warning_method,
+                   log._debug_method)
+
+    class _StderrLogger:
+        @staticmethod
+        def info(msg: str) -> None:
+            print(msg, file=sys.stderr, flush=True)
+
+        warning = info
+
+    log.register_logger(_StderrLogger)
+    try:
+        loop_thread = threading.Thread(
+            target=loop.run, name="lgb-online-loop", daemon=True)
+        loop_thread.start()
+        n = ScoringServer(registry).serve(sys.stdin, sys.stdout)
+        loop.stop_event.set()
+        loop_thread.join(timeout=60.0)
+        print(f"[loop] handled {n} requests", file=sys.stderr)
+        log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
+    finally:
+        (log._logger, log._info_method, log._warning_method,
+         log._debug_method) = prev_logger
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     params = parse_kv_args(argv)
@@ -378,7 +483,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             "usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
             "tasks: train (default), predict, save_binary, "
-            "convert_model, refit, serve",
+            "convert_model, refit, serve, loop",
             file=sys.stderr,
         )
         return 1
@@ -429,6 +534,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif task == "serve":
             _task_serve(params)  # logs its own protocol-safe summary
             return 0
+        elif task == "loop":
+            _task_loop(params)  # logs its own protocol-safe summary
+            return 0
         else:
             log.fatal(f"Unknown task {task}")
         log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
@@ -439,7 +547,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # export log lines go to stderr so a strict JSONL consumer
         # never sees a non-JSON line on the response stream
         prev_logger = None
-        if task == "serve" and (profile_dir or manifest_path):
+        if task in ("serve", "loop") and (profile_dir or manifest_path):
             prev_logger = (log._logger, log._info_method,
                            log._warning_method, log._debug_method)
 

@@ -290,6 +290,21 @@ _PARAMS: Dict[str, _P] = {
     # "round:7:kill;serve_request:2:delay:0.25"; empty = env
     # LGBMTPU_FAULT_PLAN, else disarmed (zero overhead)
     "fault_plan": ("", str, (), None),
+    # ---- online train-and-serve loop (task=loop; lightgbm_tpu/online,
+    # docs/RESILIENCE.md "Online loop") ----
+    # durable loop directory: state file, ingest spool, versioned
+    # model texts, heartbeats, event provenance
+    "loop_dir": ("online_loop", str, (), None),
+    # minimum spooled rows before a refit cycle runs
+    "loop_min_rows": (64, int, (), _pos),
+    # NEW boosting rounds per refit (the delta spliced onto v(n))
+    "loop_rounds": (10, int, (), _pos),
+    # metric-gate slack in the first metric's worse direction
+    "loop_gate_margin": (0.0, float, (), _nonneg),
+    # verdict cycles before task=loop exits; 0 = run until interrupted
+    "loop_max_cycles": (0, int, (), _nonneg),
+    # idle poll interval while waiting for ingest
+    "loop_poll_s": (0.5, float, (), _pos),
 }
 
 # alias -> canonical name

@@ -590,6 +590,11 @@ def train(
 
     # flush the async training pipeline (fast-path pending device trees)
     booster._gbdt._materialize()
+    # surface the run's sentinel verdict on the booster: the online
+    # promotion gate (online/gate.py) reads trips from the refit result
+    # directly instead of the module-global recorder summary
+    if obs_hooks is not None and obs_hooks.sentinel is not None:
+        booster.anomaly_summary = obs_hooks.sentinel.summary()
     # the stop condition is only detected every _check_every iterations on
     # the fast path; _materialize may have truncated blindly-trained
     # iterations — clamp iteration-derived state to the surviving models

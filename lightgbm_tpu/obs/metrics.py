@@ -563,6 +563,45 @@ def record_request_op(op: str, ok: bool) -> None:
                   labels=("op",)).inc(1, op=op)
 
 
+def record_promotion_event(outcome: str) -> None:
+    """One online-loop gate verdict: ``promoted`` (gate passed, registry
+    swapped), ``rejected`` (holdout metric regressed), ``rolled_back``
+    (anomaly sentinel tripped during the refit — poisoned microbatch
+    auto-revert). online/loop.py (docs/RESILIENCE.md "Online loop")."""
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_promotion_events_total",
+              "online-loop promotion gate verdicts, by outcome",
+              labels=("outcome",)).inc(1, outcome=outcome)
+
+
+def record_ingest(rows: int) -> None:
+    """One microbatch appended to the online ingest spool."""
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_ingest_batches_total",
+              "microbatches accepted through the ingest op").inc(1)
+    r.counter("lgbmtpu_ingest_rows_total",
+              "rows accepted through the ingest op").inc(rows)
+
+
+def record_loop_progress(version: int, cycle: int, offset: int) -> None:
+    """Online-loop liveness gauges: promoted version, verdict cycles,
+    and spool bytes consumed."""
+    r = _default
+    if not r.enabled:
+        return
+    r.gauge("lgbmtpu_online_version",
+            "currently promoted online-loop model version").set(version)
+    r.gauge("lgbmtpu_online_cycles_total",
+            "online-loop verdict cycles completed").set(cycle)
+    r.gauge("lgbmtpu_online_ingest_offset_bytes",
+            "ingest spool bytes consumed through the last verdict"
+            ).set(offset)
+
+
 def record_collective_wire(entry: str, nbytes: int) -> None:
     """Host-side estimate of collective payload bytes dispatched (the
     runtime twin of analysis/cost_budget.json's static wire pins)."""

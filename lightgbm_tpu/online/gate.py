@@ -1,0 +1,141 @@
+"""Promotion gate: holdout device-eval + anomaly verdict.
+
+A candidate v(n+1) is promoted only if BOTH hold:
+
+- **metric gate** — on the held-out shard, the candidate's first
+  configured metric is no worse than the incumbent's (within
+  ``loop_gate_margin``, signed by the metric's ``higher_better``);
+  the remaining configured metrics are evaluated and recorded but do
+  not veto (operator dashboards, not gates);
+- **anomaly gate** — zero anomaly-sentinel trips during the refit
+  (obs/anomaly.py): a poisoned microbatch that spikes the loss or
+  produces NaN leaves auto-reverts to v(n) (outcome ``rolled_back``)
+  instead of reaching the metric comparison.
+
+Metrics run ON DEVICE via device_metrics.DeviceEvalSet — the same
+traced evaluators the training loop uses per round — over the raw
+scores of the serving TensorForest, so the gate's arithmetic is the
+audited no-callback jaxpr (analysis entry ``online_holdout_eval``),
+not a host reimplementation. Ranking metrics (ndcg/map need query
+groups) are not gate-eligible; configure a pointwise/auc metric for
+the loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def make_holdout_evaluator(cfg, label, weight=None, num_class: int = 1):
+    """Resolve the config's metric list against the device
+    implementations and build the traced evaluator.
+
+    Returns ``(names, higher_better, fn)`` with ``fn(score (K, N)) ->
+    (m,) f32`` jit-compiled once per loop (labels are baked in — the
+    holdout shard is fixed for the life of the loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..device_metrics import DeviceEvalSet, supported_names
+    from ..metrics import create_metrics
+
+    metric_objs = create_metrics(cfg)
+    if not metric_objs:
+        raise ValueError(
+            "online loop: no metric configured and the objective has no "
+            "default — set metric= so the promotion gate can judge"
+        )
+    sup = supported_names(metric_objs)
+    if sup is None:
+        raise ValueError(
+            "online loop: configured metrics "
+            f"{[m.name for m in metric_objs]} are not device-evaluable "
+            "(ranking metrics need query groups); the promotion gate "
+            "requires device metrics"
+        )
+    names, hb = sup
+    n = int(np.asarray(label).shape[0])
+    label_dev = jnp.asarray(np.asarray(label), jnp.float32)
+    valid = jnp.ones((n,), jnp.float32)
+    w_dev = None
+    if weight is not None:
+        w_dev = jnp.asarray(np.asarray(weight), jnp.float32)
+    ev = DeviceEvalSet(cfg, list(names), list(hb), label_dev, w_dev,
+                       valid, num_class)
+    fn = jax.jit(ev.__call__)
+    return list(names), list(hb), fn
+
+
+def raw_margins(booster, X: np.ndarray) -> np.ndarray:
+    """v's raw scores on X as (K, N) f32 — scored through the serving
+    TensorForest (one fused device call), the same arithmetic the
+    registry serves. Used both as the gate's eval input and as the
+    ``init_score`` handed to the next refit."""
+    score = booster.predict(np.asarray(X), raw_score=True, device="device")
+    score = np.asarray(score, dtype=np.float32)
+    if score.ndim == 1:
+        return score[None, :]
+    return score.T.copy()  # predict returns (N, K); eval wants (K, N)
+
+
+def evaluate(fn, score_kn: np.ndarray) -> List[float]:
+    """Run the traced evaluator over a (K, N) score block."""
+    import jax.numpy as jnp
+
+    vals = fn(jnp.asarray(score_kn, jnp.float32))
+    return [float(v) for v in np.asarray(vals)]
+
+
+def decide(
+    cand: List[float],
+    incumbent: Optional[List[float]],
+    names: List[str],
+    higher_better: List[bool],
+    margin: float,
+    anomaly_trips: Dict[str, int],
+) -> Tuple[str, str]:
+    """The verdict: ``("promoted"|"rejected"|"rolled_back", reason)``.
+
+    The FIRST metric decides (the same convention early stopping uses
+    for its decision metric); ``margin`` loosens the comparison in the
+    metric's worse direction. No incumbent baseline (first promotion
+    after a fresh start) passes the metric gate by definition.
+    """
+    trips = {k: v for k, v in (anomaly_trips or {}).items() if v}
+    if trips:
+        return "rolled_back", f"anomaly sentinel tripped during refit: {trips}"
+    if incumbent is not None:
+        c, i = float(cand[0]), float(incumbent[0])
+        ok = c >= i - margin if higher_better[0] else c <= i + margin
+        if not ok:
+            word = "fell" if higher_better[0] else "rose"
+            return "rejected", (
+                f"holdout {names[0]} {word}: candidate {c:.6g} vs "
+                f"incumbent {i:.6g} (margin {margin:g})"
+            )
+    return "promoted", f"holdout {names[0]} ok: {float(cand[0]):.6g}"
+
+
+# ---------------------------------------------------------------- audit
+def trace_holdout_eval(n: int = 256, num_class: int = 1) -> Any:
+    """Jaxpr of the gate's evaluator for the static audit
+    (analysis/jaxpr_audit ENTRIES['online_holdout_eval']): auc +
+    binary_logloss over a deterministic synthetic holdout — labels are
+    arange-parity so the traced shapes and budgets are stable."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import Config
+    from ..device_metrics import DeviceEvalSet
+
+    cfg = Config({"objective": "binary",
+                  "metric": ["auc", "binary_logloss"]})
+    label = jnp.asarray((np.arange(n) % 2).astype(np.float32))
+    ev = DeviceEvalSet(cfg, ["auc", "binary_logloss"], [True, False],
+                       label, None, jnp.ones((n,), jnp.float32),
+                       num_class)
+    return jax.make_jaxpr(ev.__call__)(
+        jax.ShapeDtypeStruct((num_class, n), jnp.float32)
+    )

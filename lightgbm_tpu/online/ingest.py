@@ -1,0 +1,138 @@
+"""Durable microbatch spool between the serving transports and the loop.
+
+The serving ``ingest`` op (serving/server.py) appends labeled
+microbatches here; the refit side consumes them from a byte offset the
+loop checkpoints (online/state.py). The spool is the loop's write-ahead
+log: one JSON line per microbatch, appended with flush + fsync, so an
+accepted batch (the op replied ok) survives a SIGKILL and is either
+consumed by exactly one verdict or replayed after a crash — offsets
+only advance inside the loop's atomic state write.
+
+Torn tails are the reader's problem by design: a crash mid-append can
+leave a partial last line, and ``read_from`` stops at the last COMPLETE
+line without advancing past the tear (the next append re-extends the
+file; the partial line is never parsed because appends are atomic at
+the OS level only for short writes, which we do not rely on).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+
+SPOOL_NAME = "ingest.jsonl"
+
+
+def spool_path(loop_dir: str) -> str:
+    return os.path.join(loop_dir, SPOOL_NAME)
+
+
+class IngestSpool:
+    """Append-only JSONL microbatch spool; thread-safe (the append side
+    runs on serving request threads, the read side on the loop)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    # -------------------------------------------------------------- write
+    def append(self, rows: List[List[float]], labels: List[float],
+               weights: Optional[List[float]] = None) -> Dict[str, Any]:
+        """Validate + durably append one microbatch; returns
+        ``{"rows": n, "offset": end}`` (end = spool size after the
+        append, the offset a consumer would resume from)."""
+        n = len(rows)
+        if n == 0:
+            raise ValueError("ingest: empty microbatch")
+        if len(labels) != n:
+            raise ValueError(
+                f"ingest: {n} rows but {len(labels)} labels"
+            )
+        width = len(rows[0])
+        for r in rows:
+            if len(r) != width:
+                raise ValueError("ingest: ragged rows in microbatch")
+        batch: Dict[str, Any] = {
+            "rows": [[float(v) for v in r] for r in rows],
+            "labels": [float(v) for v in labels],
+        }
+        if weights is not None:
+            if len(weights) != n:
+                raise ValueError(
+                    f"ingest: {n} rows but {len(weights)} weights"
+                )
+            batch["weights"] = [float(v) for v in weights]
+        line = json.dumps(batch) + "\n"
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+                end = f.tell()
+        obs_metrics.record_ingest(n)
+        return {"rows": n, "offset": int(end)}
+
+    # --------------------------------------------------------------- read
+    def size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def read_from(self, offset: int) -> Tuple[List[Dict[str, Any]], int]:
+        """All complete microbatches at byte ``offset`` onward, plus the
+        offset after the last complete line (the next resume point). A
+        torn tail (crash mid-append) is left unconsumed."""
+        batches: List[Dict[str, Any]] = []
+        end = int(offset)
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(int(offset))
+                data = f.read()
+        except OSError:
+            return batches, end
+        pos = 0
+        while True:
+            nl = data.find(b"\n", pos)
+            if nl < 0:
+                break  # incomplete tail — not consumed
+            line = data[pos:nl]
+            pos = nl + 1
+            if not line.strip():
+                end = int(offset) + pos
+                continue
+            try:
+                batch = json.loads(line)
+            except json.JSONDecodeError:
+                # a torn line followed by a newline can only come from
+                # writes outside this class; stop rather than skip data
+                break
+            batches.append(batch)
+            end = int(offset) + pos
+        return batches, end
+
+
+def stack_batches(batches: List[Dict[str, Any]]):
+    """Concatenate spool batches into (X, y, w) numpy arrays (w is None
+    when no batch carried weights; batches with and without weights mix
+    as weight-1 rows)."""
+    import numpy as np
+
+    xs, ys, ws = [], [], []
+    any_w = any("weights" in b for b in batches)
+    for b in batches:
+        xs.append(np.asarray(b["rows"], dtype=np.float64))
+        ys.append(np.asarray(b["labels"], dtype=np.float64))
+        if any_w:
+            ws.append(np.asarray(
+                b.get("weights", [1.0] * len(b["labels"])),
+                dtype=np.float64))
+    X = np.concatenate(xs, axis=0)
+    y = np.concatenate(ys, axis=0)
+    w = np.concatenate(ws, axis=0) if any_w else None
+    return X, y, w

@@ -1,0 +1,95 @@
+"""Durable loop state for the online train-and-serve loop.
+
+One JSON file per loop directory carrying everything a restart needs
+to come back consistent: which model version is promoted (and where
+its text lives), how far into the ingest spool the loop has consumed,
+and the verdict counters. Written with the SAME tmp + fsync +
+``os.replace`` contract as training checkpoints
+(resilience/checkpoint.py), so a SIGKILL at any fault point leaves
+either the previous state or the next one — never a torn file — and
+the restart invariant holds: the last PERSISTED promotion is the model
+that serves.
+
+Ordering contract (online/loop.py): a candidate's model text is made
+durable (atomic write to its versioned path) BEFORE any state that
+references it, and the ingest offset only advances in the same atomic
+state write that records the cycle's verdict. A crash before the
+verdict write replays the cycle from the spool; a crash after it
+serves the verdict's outcome.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+from ..resilience.checkpoint import atomic_write_json
+from ..resilience.errors import CheckpointError
+
+SCHEMA = "lightgbm-tpu/online-loop/v1"
+
+OUTCOMES = ("promoted", "rejected", "rolled_back")
+
+
+def state_path(loop_dir: str) -> str:
+    return os.path.join(loop_dir, "loop_state.json")
+
+
+def model_path(loop_dir: str, version: int) -> str:
+    return os.path.join(loop_dir, f"model_v{int(version)}.txt")
+
+
+def fresh_state() -> Dict[str, Any]:
+    return {
+        "schema": SCHEMA,
+        "version": 0,          # last promoted version number
+        "model_path": "",      # its durable model text
+        "ingest_offset": 0,    # spool bytes consumed through the last verdict
+        "cycle": 0,            # verdict-carrying cycles completed
+        "incumbent_metrics": None,  # holdout metrics of the promoted model
+        "counts": {k: 0 for k in OUTCOMES},
+        "last_outcome": None,
+    }
+
+
+def save_state(path: str, state: Dict[str, Any]) -> str:
+    """Atomically publish the loop state (tmp + fsync + os.replace)."""
+    return atomic_write_json(path, state)
+
+
+def atomic_write_text(path: str, text: str) -> str:
+    """Model texts get the same durability contract as the state file:
+    a version path either holds a complete model or does not exist."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_state(path: str) -> Dict[str, Any]:
+    """Read loop state back; CheckpointError on a torn or alien file
+    (absent files are the caller's 'start fresh' decision)."""
+    import json
+
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except OSError as e:
+        raise CheckpointError(f"cannot read loop state {path}: {e}") from e
+    except json.JSONDecodeError as e:
+        raise CheckpointError(
+            f"loop state {path} is corrupt (torn write outside the "
+            f"atomic protocol?): {e}"
+        ) from e
+    if state.get("schema") != SCHEMA:
+        raise CheckpointError(
+            f"loop state {path} has schema {state.get('schema')!r}, "
+            f"expected {SCHEMA!r}"
+        )
+    for key in ("version", "model_path", "ingest_offset", "counts"):
+        if key not in state:
+            raise CheckpointError(f"loop state {path} is missing {key!r}")
+    return state

@@ -41,6 +41,21 @@ from .errors import CheckpointError
 SCHEMA = "lightgbm-tpu/checkpoint/v1"
 
 
+def atomic_write_json(path: str, state: Dict[str, Any]) -> str:
+    """The crash-consistency primitive every durable state file in the
+    package shares: serialize to ``<path>.tmp`` in the same directory,
+    flush + fsync, then ``os.replace``. A reader sees the old file or
+    the new one, never a torn write (the abandoned ``.tmp`` of a crash
+    mid-write is ignored by every loader)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
 def default_path(output_model: str) -> str:
     """The rolling checkpoint path for a run: ``<output_model>.ckpt``."""
     return f"{output_model}.ckpt"
@@ -91,13 +106,7 @@ def save_checkpoint(
         state["record_offset"] = int(record_offset)
     if extra:
         state.update(extra)
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(state, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    return path
+    return atomic_write_json(path, state)
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:

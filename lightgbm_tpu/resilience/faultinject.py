@@ -23,7 +23,11 @@ production code already passes through:
 - ``device_put``  — serving/dispatch.py, before each bucketed device
                     call; ``trigger`` is the 1-based Nth hit;
 - ``serve_request`` — serving/server.py, per protocol request;
-                    ``trigger`` is the 1-based Nth hit.
+                    ``trigger`` is the 1-based Nth hit;
+- ``loop_ingest`` / ``loop_refit`` / ``loop_eval`` / ``loop_promote``
+                    — online/loop.py, one per phase of each online
+                    train-and-serve cycle; ``trigger`` is the ABSOLUTE
+                    cycle index (0-based, like ``round``).
 
 Actions: ``raise`` (InjectedFault), ``kill`` (SIGKILL — a real
 no-cleanup crash for the checkpoint/resume tests), ``delay:<seconds>``
@@ -47,7 +51,10 @@ from typing import List, Optional
 from .errors import InjectedFault
 
 ENV_VAR = "LGBMTPU_FAULT_PLAN"
-SITES = ("round", "device_put", "serve_request")
+SITES = (
+    "round", "device_put", "serve_request",
+    "loop_ingest", "loop_refit", "loop_eval", "loop_promote",
+)
 ACTIONS = ("raise", "kill", "delay")
 
 

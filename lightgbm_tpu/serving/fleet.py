@@ -268,6 +268,11 @@ class ModelFleet:
     HTTP transport work unchanged — but capacity-bounded HBM residency
     instead of a device table set per model."""
 
+    # online-loop attachment points — same duck-typed surface as
+    # ModelRegistry (OnlineLoop.attach works against either store)
+    ingest_sink = None
+    health_probe = None
+
     def __init__(self, mesh=None, buckets=DEFAULT_BUCKETS,
                  warmup: bool = False, deadline_s: float = 0.0,
                  queue_cap: int = 0, host_fallback: bool = True,

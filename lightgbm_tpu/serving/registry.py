@@ -118,6 +118,13 @@ def _make_host_fallback(booster, forest):
 class ModelRegistry:
     """Thread-safe named + versioned model store (docs/SERVING.md)."""
 
+    # online-loop attachment points (duck-typed, like the fleet op's
+    # ``fleet_stats`` probe): OnlineLoop.attach installs the ingest
+    # spool and the /healthz liveness probe here; the transports reach
+    # them via getattr so a plain serving registry needs neither
+    ingest_sink = None
+    health_probe = None
+
     def __init__(self, mesh=None, buckets=DEFAULT_BUCKETS,
                  warmup: bool = False, deadline_s: float = 0.0,
                  queue_cap: int = 0, host_fallback: bool = True,

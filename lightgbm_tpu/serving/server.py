@@ -24,6 +24,9 @@ Request ops:
   {"op": "rollback", "model": "m"}
   {"op": "models"} / {"op": "stats"} / {"op": "ping"} / {"op": "quit"}
   {"op": "fleet"}  # fleet residency stats (ModelFleet registries)
+  {"op": "ingest", "rows": [[...], ...], "labels": [...],
+   "weights": [...]}  # spool a labeled microbatch for the online
+   loop (task=loop attaches the sink; docs/SERVING.md "Ingest op")
 
 Responses: {"ok": true, ...} or {"ok": false, "error": "..."}; scores
 ride as nested lists, latency from timer.latency_stats rides in
@@ -134,6 +137,17 @@ def _handle_request(registry: ModelRegistry, req: Dict[str, Any]) -> Dict[str, A
             if not hasattr(registry, "fleet_stats"):
                 raise ValueError("not a fleet registry")
             return {"ok": True, "fleet": registry.fleet_stats()}
+        if op == "ingest":
+            # durable microbatch spool for the online loop; the sink is
+            # attached by OnlineLoop.attach (same duck-typed-attribute
+            # pattern as the fleet op above)
+            sink = getattr(registry, "ingest_sink", None)
+            if sink is None:
+                raise ValueError(
+                    "no online loop attached (task=loop owns ingest)")
+            out = sink.append(req["rows"], req["labels"],
+                              req.get("weights"))
+            return {"ok": True, **out}
         if op == "quit":
             return {"ok": True, "quit": True}
         raise ValueError(f"unknown op {op!r}")
@@ -213,10 +227,25 @@ def serve_http(registry: ModelRegistry, port: int,
                 # liveness probe must not inflate the op="models"
                 # protocol counter
                 with_models = _handle_request(registry, {"op": "models"})
-                self._reply({
+                payload: Dict[str, Any] = {
                     "ok": True,
                     "models": sorted(with_models.get("models", {})),
-                })
+                }
+                # loop/worker liveness (resilience.health_report via
+                # OnlineLoop.health): an operator sees a wedged refit
+                # loop from the same endpoint that reports serving
+                # health. "ok" stays serving-liveness; the loop's own
+                # verdict rides in "health"["healthy"].
+                probe = getattr(registry, "health_probe", None)
+                if probe is not None:
+                    try:
+                        payload["health"] = probe()
+                    except Exception as e:  # noqa: BLE001 — probe must not kill /healthz
+                        payload["health"] = {
+                            "healthy": False,
+                            "error": f"{type(e).__name__}: {e}",
+                        }
+                self._reply(payload)
             elif self.path == "/metrics":
                 # Prometheus text exposition (docs/OBSERVABILITY.md):
                 # scrape-time samples from the same registry + latency

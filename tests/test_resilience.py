@@ -109,6 +109,30 @@ def test_checkpoint_corrupt_and_missing(tmp_path):
                                     str(torn))
 
 
+def test_resume_from_missing_and_torn_tmp_ignored(monkeypatch, tmp_path):
+    """Two recovery edges that must never be silent: ``resume_from=``
+    naming an absent checkpoint errors LOUDLY instead of retraining
+    from scratch over it, and a torn ``.ckpt.tmp`` left by a crash
+    mid-write is invisible to ``resume=auto`` — the atomic tmp +
+    ``os.replace`` protocol only ever publishes complete files."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+    X, y = _resume_data()
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    with pytest.raises(CheckpointError):
+        lgb.train(dict(_RESUME_PARAMS, resume="off",
+                       resume_from="absent.ckpt"), ds, num_boost_round=6)
+
+    # crash residue beside the rolling checkpoint path: resume=auto
+    # ignores the unpublished tmp and starts fresh
+    (tmp_path / "model.txt.ckpt.tmp").write_text(
+        '{"schema": "lightgbm-tpu/checkpoint/v1", "eng')
+    assert ckpt.find_resume_checkpoint(
+        "auto", "", "model.txt.ckpt") == (None, None)
+    bst = lgb.train(dict(_RESUME_PARAMS), ds, num_boost_round=6)
+    assert bst.num_trees() == 6  # trained fresh, tmp never loaded
+
+
 def test_config_fingerprint_ignores_recovery_knobs():
     base = {"objective": "binary", "num_leaves": 31, "seed": 7}
     fp = ckpt.config_fingerprint(base)

@@ -3,14 +3,20 @@
 # deterministic fault injection (resilience/faultinject.py) driving
 # crash-at-round-N + resume bit-match, SIGKILL'd subprocess resume,
 # serving deadline expiry / queue admission 503s / device-fault host
-# fallback, and anomaly rollback recovery.
+# fallback, anomaly rollback recovery, and the online-loop fault
+# matrix (tests/test_online.py): a fault plan at every loop phase —
+# kill mid-refit (loop_refit:0:kill), crash between eval and promote
+# (loop_promote:0:kill), delayed ingest (loop_ingest:0:delay:…), and a
+# poisoned-label microbatch — must leave a restart serving the last
+# PERSISTED promotion, in-process and for the SIGKILL'd task=loop CLI.
 #
 # The fast chaos tests also run inside the tier-1 gate (they carry no
 # `slow` mark); this entry point runs the FULL chaos set, including the
-# slow SIGKILL subprocess test, in isolation:
+# slow SIGKILL subprocess matrices, in isolation:
 #
 #   tools/chaos.sh                 # all chaos tests
 #   tools/chaos.sh -k sigkill      # extra pytest args pass through
+#   tools/chaos.sh -k loop         # just the online-loop fault matrix
 #
 # Forced onto the CPU backend: fault injection and recovery must work
 # exactly when the accelerator is the thing that broke.

@@ -7,7 +7,11 @@
 # smoke — ~100 models hot-loaded under serve_fleet=true with a small
 # residency capacity, scored so the LRU pager churns, one hot-swap,
 # one device-TreeSHAP contrib request, and a /metrics scrape asserting
-# per-model series. Runs on the CPU backend so it is safe anywhere.
+# per-model series; finally an online-loop smoke — task=loop serving
+# v0 over HTTP while /v1/ingest streams microbatches, one gated
+# promotion to v1, and a /metrics scrape asserting the promotion +
+# ingest counters (docs/RESILIENCE.md "Online loop"). Runs on the CPU
+# backend so it is safe anywhere.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -245,3 +249,112 @@ finally:
     except subprocess.TimeoutExpired:
         proc.kill()
 EOF3
+
+# Online-loop smoke (docs/RESILIENCE.md "Online loop"): task=loop
+# serves v0 while /v1/ingest spools labeled microbatches; the loop
+# refits, gates on the holdout shard, and promotes v1; /healthz shows
+# the loop's durable progress and /metrics carries the promotion,
+# ingest, and loop-progress series tools/chaos.sh and dashboards key
+# on.
+python - "$WORK" <<'EOF4'
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+work = sys.argv[1]
+rs = np.random.RandomState(17)
+HX = rs.randn(200, 5)
+Hy = (HX[:, 0] + HX[:, 1] > 0).astype(float)
+np.savetxt(f"{work}/holdout.csv", np.column_stack([Hy, HX]),
+           delimiter=",", fmt="%.6g")
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]
+s.close()
+proc = subprocess.Popen(
+    [sys.executable, "-m", "lightgbm_tpu", "task=loop",
+     f"input_model={work}/model.txt", f"valid_data={work}/holdout.csv",
+     f"serve_port={port}", "objective=binary", "metric=auc",
+     "num_leaves=15", f"loop_dir={work}/loop", "loop_min_rows=64",
+     "loop_rounds=4", "loop_gate_margin=0.02", "loop_poll_s=0.1",
+     "serve_buckets=16,64", "verbosity=-1"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+)
+base = f"http://127.0.0.1:{port}"
+
+
+def post(path, body, timeout=60):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+try:
+    for _ in range(240):
+        if proc.poll() is not None:
+            raise SystemExit(
+                f"loop serve exited early: {proc.stderr.read()[-2000:]}")
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=2) as r:
+                hz = json.loads(r.read())
+            assert hz["ok"]
+            break
+        except OSError:
+            time.sleep(0.5)
+    else:
+        raise SystemExit("loop serve_http never became healthy")
+    # /healthz carries the loop's durable state from the first reply
+    assert hz["health"]["loop"]["version"] == 0, hz
+
+    # stream two labeled microbatches through the ingest op
+    for seed in (61, 62):
+        rb = np.random.RandomState(seed)
+        X = rb.randn(40, 5)
+        y = (X[:, 0] + X[:, 1] > 0).astype(float)
+        out = post("/v1/ingest", {"rows": X.tolist(),
+                                  "labels": y.tolist()})
+        assert out["ok"] and out["rows"] == 40, out
+
+    # await the gated promotion (durable state drives /healthz)
+    for _ in range(600):
+        if proc.poll() is not None:
+            raise SystemExit(
+                f"loop serve died: {proc.stderr.read()[-2000:]}")
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            hz = json.loads(r.read())
+        if hz["health"]["loop"]["version"] >= 1:
+            break
+        time.sleep(0.5)
+    else:
+        raise SystemExit("online loop never promoted v1")
+    assert hz["health"]["loop"]["counts"]["promoted"] >= 1, hz
+
+    # v1 serves
+    out = post("/v1/score", {"rows": HX[:4].tolist()})
+    assert out["ok"], out
+
+    # the promotion/ingest/progress counters are on /metrics
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert ('lgbmtpu_promotion_events_total{outcome="promoted"}'
+            in text), text[:800]
+    assert "lgbmtpu_ingest_batches_total" in text
+    assert "lgbmtpu_ingest_rows_total" in text
+    assert "lgbmtpu_online_version" in text
+    print(f"serve_smoke online loop: OK (promoted v1 after 2 ingest "
+          f"batches, cycle {hz['health']['loop']['cycle']})")
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+EOF4
