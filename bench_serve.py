@@ -23,6 +23,13 @@ sides share the trained model, the process, and the machine state:
    a ModelFleet whose HBM ``capacity`` is smaller than the fleet, then
    scored round-robin so LRU paging churns; per-model p99 and the
    pager's counters land in "fleet".
+4. **gateway** — cross-process scale-out (docs/RESILIENCE.md "Serving
+   gateway"): the same model behind 1 vs N real ``task=serve`` backend
+   processes fronted by an in-process Gateway; tenants are fan-out
+   loaded and a Zipfian-skewed tenant replay is fired by concurrent
+   clients. Per-config QPS/p50/p99 plus the hedge/retry/breaker
+   counters read back from the MERGED ``/metrics`` snapshot land in
+   "gateway"; "scaleout_x" = many-backend / one-backend QPS.
 
 The dispatcher's own observability (queue depth, padded-row waste,
 coalesce ratio — what /metrics exports) is snapshotted per phase into
@@ -36,7 +43,12 @@ shape continuous batching exists for), BENCH_SERVE_THREADS
 (loaded-phase clients), BENCH_SERVE_WINDOW (outstanding futures per
 client), BENCH_SERVE_BASE_REQUESTS, BENCH_SERVE_REPLICAS,
 BENCH_SERVE_FLEET_MODELS, BENCH_SERVE_FLEET_CAPACITY,
-BENCH_SERVE_FLEET_REQUESTS, BENCH_SERVE_OUT (explicit output path),
+BENCH_SERVE_FLEET_REQUESTS, BENCH_SERVE_GATEWAY_BACKENDS
+(comma-separated backend counts to compare, default "1,4"; empty
+skips the phase), BENCH_SERVE_GATEWAY_REQUESTS,
+BENCH_SERVE_GATEWAY_THREADS, BENCH_SERVE_GATEWAY_TENANTS,
+BENCH_SERVE_GATEWAY_ZIPF (skew exponent),
+BENCH_SERVE_OUT (explicit output path),
 BENCH_SERVE_DIR (output directory, default: repo root),
 BENCH_RUN_DIR / BENCH_MANIFEST_OUT (run-manifest location — the
 manifest lives under the tmp run dir, never the repo root).
@@ -202,6 +214,208 @@ def _dispatcher_view(before: dict, after: dict, rows_scored: int) -> dict:
     }
 
 
+def _counter_family(merged: dict, name: str) -> dict:
+    fam = (merged.get("metrics") or {}).get(name) or {}
+    return {k: v for k, v in (fam.get("values") or {}).items()}
+
+
+# the resilience counters the gateway phase reports per config
+_GW_FAMILIES = (
+    "lgbmtpu_gateway_hedges_total",
+    "lgbmtpu_gateway_retries_total",
+    "lgbmtpu_gateway_breaker_transitions_total",
+    "lgbmtpu_gateway_attempts_total",
+)
+
+
+def _diff_counters(cur: dict, floor: dict) -> dict:
+    """Per-config view of process-cumulative counters: cur - floor,
+    zero rows dropped (label keys render identically in the registry
+    snapshot and the merged pane)."""
+    out = {}
+    for k, v in cur.items():
+        d = float(v) - float(floor.get(k, 0.0))
+        if d:
+            out[k] = int(d) if d.is_integer() else d
+    return out
+
+
+def _gateway_phase(model_file: str, model_str: str, n_feat: int,
+                   batch: int) -> dict | None:
+    """Phase 4: 1 vs N real task=serve backend processes behind an
+    in-process Gateway, Zipfian tenant replay, counters read back from
+    the merged /metrics snapshot. Returns None when disabled
+    (BENCH_SERVE_GATEWAY_BACKENDS empty)."""
+    import socket
+    import subprocess
+    import urllib.request
+
+    from lightgbm_tpu.serving.gateway import Gateway
+
+    spec = os.environ.get("BENCH_SERVE_GATEWAY_BACKENDS", "1,4")
+    counts = [int(x) for x in spec.split(",") if x.strip()]
+    if not counts:
+        return None
+    n_requests = _env_int("BENCH_SERVE_GATEWAY_REQUESTS", 600)
+    n_threads = _env_int("BENCH_SERVE_GATEWAY_THREADS", 6)
+    n_tenants = _env_int("BENCH_SERVE_GATEWAY_TENANTS", 4)
+    zipf_a = float(os.environ.get("BENCH_SERVE_GATEWAY_ZIPF", "1.2"))
+
+    # Zipf-by-rank tenant weights: tenant r gets 1/(r+1)^a of the
+    # traffic — the skew multi-tenant serving actually sees
+    tenants = [f"tenant{t:02d}" for t in range(n_tenants)]
+    w = np.array([1.0 / (r + 1) ** zipf_a for r in range(n_tenants)])
+    w /= w.sum()
+    replay = np.random.RandomState(11).choice(n_tenants,
+                                              size=n_requests, p=w)
+
+    env = dict(os.environ)
+    # restart/re-spawn compiles become cache hits across backends
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        tempfile.gettempdir(), "lgbmtpu_bench_gateway_cache"))
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn(port: int):
+        return subprocess.Popen(
+            [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+             f"input_model={model_file}", f"serve_port={port}",
+             "serve_buckets=16,64", "serve_warmup=true",
+             "verbosity=-1"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    def wait_ready(url: str, proc, timeout: float = 600.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(f"bench backend died "
+                                   f"rc={proc.returncode}")
+            try:
+                with urllib.request.urlopen(url + "/readyz",
+                                            timeout=2) as r:
+                    if r.status == 200:
+                        return
+            except OSError:
+                pass
+            time.sleep(0.2)
+        raise RuntimeError(f"bench backend at {url} never ready")
+
+    rs = np.random.RandomState(3)
+    rows = rs.randn(batch, n_feat).astype(np.float32).tolist()
+    configs: dict = {}
+    for k in counts:
+        ports = [free_port() for _ in range(k)]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        procs = [spawn(p) for p in ports]
+        gw = None
+        try:
+            for u, p in zip(urls, procs):
+                wait_ready(u, p)
+            gw = Gateway(urls, retries=3, backoff_base_s=0.02,
+                         health_interval_s=0.5, hedge_budget=0.1,
+                         attempt_timeout_s=60.0)
+            gw.start(wait_ready_s=30.0)
+            # the gateway records into the bench process's registry, so
+            # counters are cumulative across configs: floor them here
+            # and report per-config deltas
+            from lightgbm_tpu.obs.metrics import default_registry
+            snap = default_registry().snapshot()
+            floor = {name: dict(snap.get(name) or {})
+                     for name in _GW_FAMILIES}
+            for t in tenants:
+                status, resp = gw.handle("load", {
+                    "model": t, "model_str": model_str,
+                    "num_features": n_feat})
+                if status != 200:
+                    raise RuntimeError(f"tenant load failed: {resp}")
+            # warm every (tenant, backend) pair off the clock
+            for _ in range(2 * k):
+                for t in tenants:
+                    status, _ = gw.handle("score",
+                                          {"model": t, "rows": rows})
+                    if status != 200:
+                        raise RuntimeError("warmup score failed")
+            lat: list = []
+            lat_lock = threading.Lock()
+            failures = [0]
+            cursor = [0]
+
+            def worker() -> None:
+                local: list = []
+                while True:
+                    with lat_lock:
+                        i = cursor[0]
+                        if i >= n_requests:
+                            break
+                        cursor[0] += 1
+                    t0 = time.perf_counter()
+                    status, _resp = gw.handle("score", {
+                        "model": tenants[replay[i]], "rows": rows,
+                        "deadline_ms": 60000})
+                    dt = time.perf_counter() - t0
+                    if status == 200:
+                        local.append(dt)
+                    else:
+                        with lat_lock:
+                            failures[0] += 1
+                with lat_lock:
+                    lat.extend(local)
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=worker)
+                       for _ in range(n_threads)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            summary = _lat_summary(lat, wall, batch)
+            summary["threads"] = n_threads
+            summary["failures"] = failures[0]
+            # resilience counters come from the MERGED /metrics pane —
+            # the same single-pane view operators scrape
+            merged = gw.merged_metrics()
+            summary["merged_processes"] = merged.get("processes")
+            for label, fam in (("hedges", _GW_FAMILIES[0]),
+                               ("retries", _GW_FAMILIES[1]),
+                               ("breaker_transitions", _GW_FAMILIES[2]),
+                               ("attempts", _GW_FAMILIES[3])):
+                summary[label] = _diff_counters(
+                    _counter_family(merged, fam), floor[fam])
+            configs[f"backends_{k}"] = summary
+        finally:
+            if gw is not None:
+                gw.stop()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
+    lo, hi = min(counts), max(counts)
+    out = {
+        "requests": n_requests,
+        "threads": n_threads,
+        "tenants": n_tenants,
+        "zipf_a": zipf_a,
+        "configs": configs,
+    }
+    if lo != hi:
+        base_qps = configs[f"backends_{lo}"]["qps"]
+        out["scaleout_x"] = (
+            round(configs[f"backends_{hi}"]["qps"] / base_qps, 2)
+            if base_qps else 0.0)
+    return out
+
+
 def run_bench() -> dict:
     import jax
 
@@ -302,6 +516,21 @@ def run_bench() -> dict:
     }
     fleet.close()
 
+    # ---- phase 4: cross-process scale-out behind the gateway
+    gateway_result = None
+    try:
+        with tempfile.NamedTemporaryFile(
+                mode="w", suffix=".txt", delete=False) as f:
+            model_file = f.name
+            f.write(bst.model_to_string())
+        try:
+            gateway_result = _gateway_phase(
+                model_file, bst.model_to_string(), n_feat, batch)
+        finally:
+            os.unlink(model_file)
+    except Exception as e:  # noqa: BLE001 — scale-out phase must not sink the artifact
+        gateway_result = {"error": f"{type(e).__name__}: {e}"}
+
     result = {
         "schema": SCHEMA,
         "metric": "serve_score_qps",
@@ -317,6 +546,7 @@ def run_bench() -> dict:
         "fleet_size": fleet_models,
         "models": names,
         "fleet": fleet_result,
+        "gateway": gateway_result,
         "model": {"trees": n_trees, "leaves": n_leaves,
                   "features": n_feat, "train_rows": train_rows,
                   "train_s": round(train_s, 2)},

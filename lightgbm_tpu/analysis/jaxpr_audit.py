@@ -765,6 +765,7 @@ def audit_faultinject() -> AuditResult:
         "serving/dispatch.py",        # host side of the device call
         "serving/server.py",          # request transport
         "serving/fleet.py",           # HBM paging (fleet_page site)
+        "serving/gateway.py",         # gw_* request/drain sites
         "online/loop.py",             # loop_* phase sites per cycle
     }
     sites: List[str] = []

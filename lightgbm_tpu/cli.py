@@ -349,7 +349,36 @@ def _task_serve(params: Dict[str, str]) -> None:
             )
         registry.load(cfg.serve_model_name, model_path)
         if cfg.serve_port > 0:
-            serve_http(registry, cfg.serve_port, cfg.serve_host)
+            import signal
+            import threading
+
+            # SIGTERM = graceful drain: readiness flips false (the
+            # gateway stops routing here), new POSTs shed 503
+            # shutdown, in-flight requests finish (server_close joins
+            # handler threads), then the process exits — the backend
+            # half of tools/gateway_rolling.sh
+            draining = threading.Event()  # lint: allow[per-call-lock] — one per process, shared with every handler thread
+            httpd = serve_http(
+                registry, cfg.serve_port, cfg.serve_host, block=False,
+                socket_timeout_s=cfg.serve_socket_timeout_s,
+                max_body_mb=cfg.serve_max_body_mb, draining=draining)
+
+            def _drain(signum, frame):  # noqa: ARG001 — signal API
+                draining.set()
+                # shutdown() must run off the serve_forever thread
+                threading.Thread(target=httpd.shutdown,
+                                 daemon=True).start()
+
+            try:
+                signal.signal(signal.SIGTERM, _drain)
+            except ValueError:
+                pass  # not the main thread (in-process callers)
+            try:
+                httpd.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                httpd.server_close()
         else:
             n = ScoringServer(registry).serve(sys.stdin, sys.stdout)
             print(f"[serve] handled {n} requests", file=sys.stderr)
@@ -360,6 +389,73 @@ def _task_serve(params: Dict[str, str]) -> None:
     finally:
         (log._logger, log._info_method, log._warning_method,
          log._debug_method) = prev_logger
+
+
+def _task_gateway(params: Dict[str, str]) -> None:
+    """task=gateway: the resilient serving gateway
+    (serving/gateway.py, docs/RESILIENCE.md "Serving gateway") — a
+    host-side HTTP front end spreading traffic over the ``task=serve``
+    backend processes named by ``gateway_backends=`` (comma-separated
+    base URLs). Least-outstanding balancing over /readyz-passing
+    backends, full-jitter retries and latency-triggered hedging for
+    idempotent ops, per-backend circuit breakers, end-to-end deadline
+    propagation, and SIGTERM graceful drain. ``GET /metrics`` serves
+    the MERGED fleet exposition (gateway + every live backend)."""
+    import signal
+    import threading
+
+    from .config import Config
+    from .resilience import faultinject
+    from .serving.gateway import Gateway, gateway_http
+
+    t0 = time.time()
+    cfg = Config(dict(params))
+    # chaos testing: arm the gw_* sites before any request flows
+    faultinject.configure(cfg.fault_plan)
+    urls = [u.strip() for u in str(cfg.gateway_backends).split(",")
+            if u.strip()]
+    if not urls:
+        log.fatal("task=gateway needs gateway_backends= "
+                  "(comma-separated backend base URLs)")
+    gw = Gateway(
+        urls,
+        retries=cfg.gateway_retries,
+        backoff_base_s=cfg.gateway_backoff_base_s,
+        hedge_quantile=cfg.gateway_hedge_quantile,
+        hedge_budget=cfg.gateway_hedge_budget,
+        breaker_failures=cfg.gateway_breaker_failures,
+        breaker_cooldown_s=cfg.gateway_breaker_cooldown_s,
+        default_deadline_ms=cfg.gateway_deadline_ms,
+        health_interval_s=cfg.gateway_health_interval_s,
+        attempt_timeout_s=cfg.serve_socket_timeout_s,
+    )
+    gw.start()
+    httpd = gateway_http(
+        gw, cfg.gateway_port, cfg.gateway_host, block=False,
+        max_body_mb=cfg.serve_max_body_mb,
+        socket_timeout_s=cfg.serve_socket_timeout_s)
+
+    def _drain(signum, frame):  # noqa: ARG001 — signal API
+        def _go() -> None:
+            # deregister (readyz 503) + shed new work, finish
+            # in-flight, then stop the listener
+            gw.drain(cfg.gateway_drain_timeout_s)
+            httpd.shutdown()
+
+        threading.Thread(target=_go, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _drain)
+    except ValueError:
+        pass  # not the main thread (in-process callers)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.stop()
+        httpd.server_close()
+    log.info(f"Finished, elapsed {time.time()-t0:.2f} seconds")
 
 
 def _task_loop(params: Dict[str, str]) -> None:
@@ -483,7 +579,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             "usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
             "tasks: train (default), predict, save_binary, "
-            "convert_model, refit, serve, loop",
+            "convert_model, refit, serve, gateway, loop",
             file=sys.stderr,
         )
         return 1
@@ -534,6 +630,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif task == "serve":
             _task_serve(params)  # logs its own protocol-safe summary
             return 0
+        elif task == "gateway":
+            _task_gateway(params)  # logs its own summary
+            return 0
         elif task == "loop":
             _task_loop(params)  # logs its own protocol-safe summary
             return 0
@@ -547,7 +646,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # export log lines go to stderr so a strict JSONL consumer
         # never sees a non-JSON line on the response stream
         prev_logger = None
-        if task in ("serve", "loop") and (profile_dir or manifest_path):
+        if task in ("serve", "gateway", "loop") \
+                and (profile_dir or manifest_path):
             prev_logger = (log._logger, log._info_method,
                            log._warning_method, log._debug_method)
 

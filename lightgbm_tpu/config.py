@@ -248,6 +248,37 @@ _PARAMS: Dict[str, _P] = {
     "serve_fleet": (False, bool, (), None),
     "serve_fleet_capacity": (32, int, (), _pos),
     "serve_fleet_slots": (8, int, (), _pos),
+    # hardened HTTP transport (server.py): per-connection socket
+    # timeout (a stalled client answers 408 instead of pinning a
+    # handler thread) and the request-body byte cap (413 over it)
+    "serve_socket_timeout_s": (30.0, float, (), _pos),
+    "serve_max_body_mb": (64.0, float, (), _pos),
+    # ---- serving gateway (task=gateway; serving/gateway.py,
+    # docs/RESILIENCE.md "Serving gateway") ----
+    # comma-separated backend base URLs (e.g.
+    # "http://127.0.0.1:8101,http://127.0.0.1:8102"); the gateway
+    # spreads traffic over them with least-outstanding balancing
+    "gateway_backends": ("", str, (), None),
+    "gateway_port": (8100, int, (), _nonneg),
+    "gateway_host": ("127.0.0.1", str, (), None),
+    # retry rounds for idempotent ops (full-jitter backoff between)
+    "gateway_retries": (2, int, (), _nonneg),
+    "gateway_backoff_base_s": (0.05, float, (), _pos),
+    # hedging: fire a duplicate score/contrib attempt once the primary
+    # outlives this rolling latency quantile; budget caps hedges to
+    # this fraction of traffic (0 disables hedging)
+    "gateway_hedge_quantile": (0.95, float, (), _pos),
+    "gateway_hedge_budget": (0.05, float, (), _nonneg),
+    # per-backend circuit breaker: consecutive failures to trip, and
+    # the open->half_open cooldown
+    "gateway_breaker_failures": (5, int, (), _pos),
+    "gateway_breaker_cooldown_s": (2.0, float, (), _pos),
+    # default per-request deadline budget when the client sends none
+    # (0 = no deadline); expired work sheds 503 + Retry-After
+    "gateway_deadline_ms": (0.0, float, (), _nonneg),
+    # backend /readyz probe cadence and SIGTERM drain budget
+    "gateway_health_interval_s": (1.0, float, (), _pos),
+    "gateway_drain_timeout_s": (30.0, float, (), _pos),
     # ---- observability (lightgbm_tpu/obs, docs/OBSERVABILITY.md) ----
     # runtime switch for the phase timer (the env LIGHTGBM_TPU_TIMETAG
     # analog of the reference's compile-time USE_TIMETAG) — no restart

@@ -48,9 +48,12 @@ _SUMMED_KINDS = ("counter", "histogram")
 def snapshot_dict(registry=None, process: Optional[int] = None
                   ) -> Dict[str, Any]:
     """One process's registry as a JSON-serializable snapshot (samples
-    keyed by rendered label string, kind preserved per metric)."""
-    import jax
+    keyed by rendered label string, kind preserved per metric).
 
+    With an explicit ``process`` this is jax-free — the serving
+    gateway (``task=gateway``, a pure host process) snapshots its own
+    registry without dragging the device runtime in; only the
+    ``process=None`` default asks jax for the process index."""
     from .metrics import _render_labels, default_registry
 
     reg = registry if registry is not None else default_registry()
@@ -62,6 +65,8 @@ def snapshot_dict(registry=None, process: Optional[int] = None
         fam["values"][_render_labels(s.labels)] = float(s.value)
     if process is None:
         try:
+            import jax
+
             process = jax.process_index()
         except Exception:  # noqa: BLE001 — snapshot must not need a backend
             process = 0
@@ -195,6 +200,27 @@ def merge(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
 
 def merge_files(paths: Iterable[str]) -> Dict[str, Any]:
     return merge([read_snapshot(p) for p in sorted(paths)])
+
+
+def render_merged(merged: Dict[str, Any]) -> str:
+    """A merged snapshot back to text exposition (format 0.0.4) — the
+    gateway's single-pane ``/metrics``: one scrape body covering the
+    gateway process plus every live backend. Gauge min/max spreads are
+    dropped (Prometheus has no native spread sample; the JSON view
+    keeps them)."""
+    lines: List[str] = []
+    metrics = merged.get("metrics") or {}
+    for name in sorted(metrics):
+        fam = metrics[name]
+        kind = fam.get("kind", "untyped")
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {kind}")
+        for key in sorted(fam.get("values") or {}):
+            v = fam["values"][key]
+            vs = str(int(v)) if float(v).is_integer() else repr(float(v))
+            lines.append(f"{name}{key} {vs}")
+    return "\n".join(lines) + "\n"
 
 
 # ---------------------------------------------------- recorder streams

@@ -613,6 +613,86 @@ def record_collective_wire(entry: str, nbytes: int) -> None:
               labels=("entry",)).inc(nbytes, entry=entry)
 
 
+# gateway bridges (serving/gateway.py). Label/naming conventions in
+# docs/OBSERVABILITY.md "Gateway metrics": outcome is the GATEWAY
+# verdict (ok/failed/shed/deadline/unavailable/drain/fanout_partial),
+# result is one ATTEMPT's fate (ok/5xx/error/cancelled), breaker state
+# renders as a numeric gauge (0 closed / 1 half_open / 2 open) plus a
+# transitions counter.
+_BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def record_gateway_request(op: str, outcome: str, seconds: float) -> None:
+    """One client request through Gateway.handle, end to end."""
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_gateway_requests_total",
+              "gateway client requests, by op and outcome",
+              labels=("op", "outcome")).inc(1, op=op, outcome=outcome)
+    r.histogram("lgbmtpu_gateway_request_seconds",
+                "gateway end-to-end request latency (incl. retries "
+                "and hedges)", labels=("op",)).observe(seconds, op=op)
+
+
+def record_gateway_attempt(backend: str, result: str) -> None:
+    """One backend attempt (primary, retry, or hedge)."""
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_gateway_attempts_total",
+              "backend attempts, by backend and result",
+              labels=("backend", "result")).inc(
+        1, backend=backend, result=result)
+
+
+def record_gateway_retry() -> None:
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_gateway_retries_total",
+              "retry rounds scheduled (full-jitter backoff)").inc(1)
+
+
+def record_gateway_hedge(outcome: str) -> None:
+    """Hedge verdicts: ``fired`` / ``won`` / ``denied_budget`` /
+    ``no_backend``."""
+    r = _default
+    if not r.enabled:
+        return
+    r.counter("lgbmtpu_gateway_hedges_total",
+              "hedged-attempt verdicts, by outcome",
+              labels=("outcome",)).inc(1, outcome=outcome)
+
+
+def record_gateway_breaker(backend: str, state: str) -> None:
+    """Breaker transition: new state as a coded gauge + a counter."""
+    r = _default
+    if not r.enabled:
+        return
+    r.gauge("lgbmtpu_gateway_breaker_state",
+            "circuit state per backend (0 closed, 1 half_open, 2 open)",
+            labels=("backend",)).set(
+        _BREAKER_STATE_CODE.get(state, -1), backend=backend)
+    r.counter("lgbmtpu_gateway_breaker_transitions_total",
+              "breaker transitions, by backend and destination state",
+              labels=("backend", "to")).inc(1, backend=backend, to=state)
+
+
+def record_gateway_pool(alive: int, ready: int, total: int) -> None:
+    r = _default
+    if not r.enabled:
+        return
+    r.gauge("lgbmtpu_gateway_backends_alive",
+            "backends answering HTTP at the last probe sweep"
+            ).set(alive)
+    r.gauge("lgbmtpu_gateway_backends_ready",
+            "backends passing /readyz at the last probe sweep"
+            ).set(ready)
+    r.gauge("lgbmtpu_gateway_backends_total",
+            "configured backend slots").set(total)
+
+
 def record_native_build(seconds: float, ok: bool) -> None:
     r = _default
     if not r.enabled:

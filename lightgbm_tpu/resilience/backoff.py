@@ -32,6 +32,21 @@ def backoff_delay(attempt: int, base_s: float = 0.5,
     return min(float(base_s) * (2.0 ** (attempt - 1)), float(cap_s))
 
 
+def full_jitter_delay(attempt: int, base_s: float = 0.5,
+                      cap_s: float = 120.0,
+                      rand: Optional[Callable[[], float]] = None) -> float:
+    """AWS-style "full jitter" on the same capped-exponential
+    schedule: uniform in [0, backoff_delay(attempt)]. Decorrelates
+    retry storms — N clients that failed together do NOT retry
+    together (the serving gateway's retry policy; tests pass a seeded
+    ``rand`` for determinism)."""
+    if rand is None:
+        import random
+
+        rand = random.random
+    return rand() * backoff_delay(attempt, base_s, cap_s)
+
+
 def retry_call(
     fn: Callable,
     *,

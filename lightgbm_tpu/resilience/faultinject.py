@@ -27,7 +27,16 @@ production code already passes through:
 - ``loop_ingest`` / ``loop_refit`` / ``loop_eval`` / ``loop_promote``
                     — online/loop.py, one per phase of each online
                     train-and-serve cycle; ``trigger`` is the ABSOLUTE
-                    cycle index (0-based, like ``round``).
+                    cycle index (0-based, like ``round``);
+- ``gw_connect`` / ``gw_slow_backend`` / ``gw_backend_5xx``
+                    — serving/gateway.py, per backend attempt: before
+                    the socket opens / before the response read (a
+                    ``delay`` clause stalls the backend answer) /
+                    after the answer (a ``raise`` clause turns it into
+                    a backend failure); ``trigger`` is the 1-based Nth
+                    hit;
+- ``gw_drain``      — serving/gateway.py, once per ``drain()`` call
+                    (SIGTERM path); ``trigger`` is the Nth drain.
 
 Actions: ``raise`` (InjectedFault), ``kill`` (SIGKILL — a real
 no-cleanup crash for the checkpoint/resume tests), ``delay:<seconds>``
@@ -54,6 +63,7 @@ ENV_VAR = "LGBMTPU_FAULT_PLAN"
 SITES = (
     "round", "device_put", "serve_request",
     "loop_ingest", "loop_refit", "loop_eval", "loop_promote",
+    "gw_connect", "gw_backend_5xx", "gw_slow_backend", "gw_drain",
 )
 ACTIONS = ("raise", "kill", "delay")
 

@@ -20,7 +20,12 @@ this package is the TPU analog. Three pieces:
 - ``fleet``: a **multi-tenant model fleet** — hundreds of registry
   models resident as stacked forest tables with LRU HBM paging,
   per-model QoS and metrics, and on-device TreeSHAP
-  (``pred_contrib``) over the packed tables.
+  (``pred_contrib``) over the packed tables;
+- ``gateway``: a **resilient scale-out front end** — health-checked
+  least-outstanding balancing over N backend processes with retries,
+  latency-triggered hedging, per-backend circuit breakers, deadline
+  propagation, and zero-downtime drain (``task=gateway``,
+  docs/RESILIENCE.md "Serving gateway").
 
 See docs/SERVING.md for the architecture.
 """
@@ -28,8 +33,16 @@ See docs/SERVING.md for the architecture.
 from .dispatch import DEFAULT_BUCKETS, BucketDispatcher, MicroBatcher
 from .fleet import ModelFleet
 from .forest import TensorForest
+from .gateway import (
+    BackendPool,
+    CircuitBreaker,
+    Gateway,
+    HedgePolicy,
+    RollingLatency,
+    gateway_http,
+)
 from .registry import ModelRegistry
-from .server import ScoringServer, serve_http
+from .server import ScoringServer, readiness, serve_http
 
 __all__ = [
     "TensorForest",
@@ -40,4 +53,11 @@ __all__ = [
     "ModelFleet",
     "ScoringServer",
     "serve_http",
+    "readiness",
+    "Gateway",
+    "gateway_http",
+    "CircuitBreaker",
+    "HedgePolicy",
+    "RollingLatency",
+    "BackendPool",
 ]

@@ -191,18 +191,86 @@ class ScoringServer:
         return handled
 
 
+def readiness(registry: ModelRegistry,
+              draining: Optional[Any] = None) -> Dict[str, Any]:
+    """The /readyz verdict (liveness is /healthz: "the process is
+    up"). Ready means: not draining, >=1 model loaded, microbatch
+    queue depth under the admission cap, and — when an online loop is
+    attached — its heartbeat fresh. The serving gateway routes traffic
+    on THIS verdict only (docs/RESILIENCE.md "Serving gateway")."""
+    out: Dict[str, Any] = {
+        "ok": False, "role": "backend",
+        "draining": bool(draining is not None and draining.is_set()),
+    }
+    if out["draining"]:
+        out["reason"] = "draining"
+        return out
+    # registry.models() directly — NOT _handle_request, which passes
+    # the serve_request fault site: a chaos plan's hit counters must
+    # count real protocol requests, never health probes
+    try:
+        models = registry.models()
+    except Exception as e:  # noqa: BLE001 — a broken registry is "not ready", not a crash
+        out["reason"] = f"registry: {type(e).__name__}: {e}"
+        return out
+    out["models"] = len(models or {})
+    if not models:
+        out["reason"] = "no models loaded"
+        return out
+    cap = int(getattr(registry, "queue_cap", 0) or 0)
+    depths = default_registry().snapshot().get(
+        "lgbmtpu_serve_queue_depth") or {}
+    depth = int(max(depths.values(), default=0))
+    out["queue_depth"] = depth
+    out["queue_cap"] = cap
+    if cap > 0 and depth >= cap:
+        out["reason"] = "queue at admission cap"
+        return out
+    probe = getattr(registry, "health_probe", None)
+    if probe is not None:
+        try:
+            health = probe()
+        except Exception as e:  # noqa: BLE001 — probe must not kill /readyz
+            health = {"healthy": False,
+                      "error": f"{type(e).__name__}: {e}"}
+        out["health"] = health
+        if not health.get("healthy", True):
+            out["reason"] = "loop heartbeat stale"
+            return out
+    out["ok"] = True
+    return out
+
+
 def serve_http(registry: ModelRegistry, port: int,
-               host: str = "127.0.0.1", block: bool = True):
+               host: str = "127.0.0.1", block: bool = True,
+               socket_timeout_s: float = 30.0,
+               max_body_mb: float = 64.0,
+               draining: Optional[Any] = None):
     """HTTP server: POST /v1/<op> with the same JSON bodies ("op"
     inferred from the path); GET /v1/models, /v1/stats, /healthz,
-    /metrics (Prometheus text exposition).
+    /readyz (liveness vs readiness — the gateway registers on
+    readiness only), /metrics (Prometheus text exposition).
     port=0 binds an ephemeral port. With block=True (the task=serve
     mode) returns only when the process is interrupted; block=False
     returns the bound httpd immediately (serve it from your own
-    thread; tests do this) — call .shutdown() to stop."""
+    thread; tests do this) — call .shutdown() to stop.
+
+    Hardened transport: every accepted connection carries a
+    ``socket_timeout_s`` timeout (a stalled or dead peer times out
+    instead of pinning a handler thread forever; the stall answers
+    408), and request bodies are bounded by ``max_body_mb`` (413 over
+    the cap). ``draining`` is an optional threading.Event the SIGTERM
+    path sets: readiness flips false so the gateway stops routing
+    here, while in-flight requests finish (cli._task_serve)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    max_body = int(float(max_body_mb) * 1024 * 1024)
+
     class Handler(BaseHTTPRequestHandler):
+        # per-connection socket timeout (BaseRequestHandler.setup
+        # applies it): the slow-client hardening
+        timeout = float(socket_timeout_s)
+
         def _reply(self, resp: Dict[str, Any], code: int = 200) -> None:
             body = json.dumps(resp).encode()
             if code == 200 and not resp.get("ok", True):
@@ -223,13 +291,17 @@ def serve_http(registry: ModelRegistry, port: int,
 
         def do_GET(self):  # noqa: N802 — http.server API
             if self.path in ("/healthz", "/health"):
-                # internal listing via the UNCOUNTED inner handler: a
-                # liveness probe must not inflate the op="models"
-                # protocol counter
-                with_models = _handle_request(registry, {"op": "models"})
+                # registry read, NOT the request handler: a liveness
+                # probe must not inflate the op="models" protocol
+                # counter nor consume fault-plan hits (chaos plans
+                # count real protocol requests only)
+                try:
+                    listing = sorted(registry.models() or {})
+                except Exception:  # noqa: BLE001 — liveness is "process up", not "registry ok"
+                    listing = []
                 payload: Dict[str, Any] = {
                     "ok": True,
-                    "models": sorted(with_models.get("models", {})),
+                    "models": listing,
                 }
                 # loop/worker liveness (resilience.health_report via
                 # OnlineLoop.health): an operator sees a wedged refit
@@ -246,6 +318,9 @@ def serve_http(registry: ModelRegistry, port: int,
                             "error": f"{type(e).__name__}: {e}",
                         }
                 self._reply(payload)
+            elif self.path == "/readyz":
+                ready = readiness(registry, draining)
+                self._reply(ready, 200 if ready["ok"] else 503)
             elif self.path == "/metrics":
                 # Prometheus text exposition (docs/OBSERVABILITY.md):
                 # scrape-time samples from the same registry + latency
@@ -269,14 +344,42 @@ def serve_http(registry: ModelRegistry, port: int,
                 self._reply({"ok": False, "error": "not found"}, 404)
 
         def do_POST(self):  # noqa: N802 — http.server API
-            n = int(self.headers.get("Content-Length", 0))
             try:
-                req = json.loads(self.rfile.read(n) or b"{}")
+                n = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                self._reply({"ok": False,
+                             "error": "bad Content-Length"}, 400)
+                return
+            if n > max_body:
+                # bounded body read: refuse before reading, so an
+                # oversize (or lying) client cannot balloon the heap
+                self._reply({"ok": False,
+                             "error": f"body over {max_body} bytes"}, 413)
+                return
+            try:
+                raw = self.rfile.read(n)
+            except (OSError, TimeoutError) as e:
+                # stalled client: the per-connection socket timeout
+                # fired mid-body — answer 408 and free the thread
+                self._reply({"ok": False, "error": f"body read: {e}"},
+                            408)
+                return
+            try:
+                req = json.loads(raw or b"{}")
             except json.JSONDecodeError as e:
                 self._reply({"ok": False, "error": f"bad json: {e}"}, 400)
                 return
             if self.path.startswith("/v1/"):
                 req.setdefault("op", self.path[len("/v1/"):])
+            if draining is not None and draining.is_set():
+                # stop ACCEPTING new work; in-flight requests on other
+                # threads run to completion (the SIGTERM drain
+                # contract; gateway peers retry elsewhere on the 503)
+                self._reply({"ok": False, "op": req.get("op"),
+                             "error": "server draining",
+                             "error_kind": "shutdown",
+                             "retry_after_s": 1.0})
+                return
             if req.get("op") == "quit":  # no remote shutdown over HTTP
                 self._reply({"ok": False, "error": "quit is stdio-only"}, 400)
                 return
@@ -286,6 +389,12 @@ def serve_http(registry: ModelRegistry, port: int,
             log.debug(f"serve http: {fmt % args}")
 
     httpd = ThreadingHTTPServer((host, port), Handler)
+    # drain contract: ThreadingMixIn only TRACKS (and joins at
+    # server_close) non-daemon handler threads — with the stock
+    # daemon_threads=True a SIGTERM drain would drop in-flight
+    # responses at process exit. Exit latency stays bounded by the
+    # per-connection socket timeout above.
+    httpd.daemon_threads = False
     log.info(f"serving on http://{host}:{httpd.server_address[1]}/v1")
     if not block:
         return httpd

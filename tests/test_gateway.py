@@ -1,0 +1,725 @@
+"""Serving gateway (lightgbm_tpu/serving/gateway.py, ``task=gateway``,
+docs/RESILIENCE.md "Serving gateway").
+
+The tier-1 half of this file is deliberately socket- and sleep-free:
+the circuit breaker, hedge budget, jitter schedule, pool ranking,
+deadline shed, and /readyz verdict are pure state machines driven by a
+fake clock, so they run in milliseconds inside the gate. Everything
+that opens a socket, spawns a backend process, or sleeps is marked
+``slow``; the fault matrix (kill -9 a backend under concurrent load,
+SIGTERM drain with a request in flight, hedging past a stalled
+attempt) is additionally ``chaos`` and runs via tools/chaos.sh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.metrics import default_registry, record_queue_depth
+from lightgbm_tpu.resilience import faultinject
+from lightgbm_tpu.resilience.backoff import backoff_delay, full_jitter_delay
+from lightgbm_tpu.serving import (
+    BackendPool,
+    CircuitBreaker,
+    Gateway,
+    HedgePolicy,
+    ModelRegistry,
+    gateway_http,
+    readiness,
+    serve_http,
+)
+from lightgbm_tpu.serving.gateway import FANOUT_OPS, HEDGED_OPS, IDEMPOTENT_OPS
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _disarm_fault_plan():
+    """Chaos tests arm process-global fault plans; none may leak."""
+    yield
+    faultinject.disarm()
+
+
+class _Clock:
+    """Injectable monotonic clock for the breaker state machine."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ----------------------------------------------------- circuit breaker
+def test_breaker_consecutive_trip_and_probe_cycle():
+    clk = _Clock()
+    seen = []
+    br = CircuitBreaker(failures=3, cooldown_s=2.0, now=clk,
+                        on_transition=lambda o, n: seen.append((o, n)))
+    assert br.state == "closed" and br.allow()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # 2 consecutive < 3
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    clk.advance(1.9)
+    assert br.state == "open"  # cooldown not elapsed
+    clk.advance(0.2)
+    assert br.allow()  # aged into half_open, probe slot claimed
+    br.record_success()  # probe succeeded
+    assert br.state == "closed" and br.allow()
+    assert seen == [("closed", "open"), ("open", "half_open"),
+                    ("half_open", "closed")]
+
+
+def test_breaker_half_open_probe_bound_and_reopen():
+    clk = _Clock()
+    br = CircuitBreaker(failures=1, cooldown_s=1.0, half_open_max=1,
+                        now=clk)
+    br.record_failure()
+    assert br.state == "open"
+    clk.advance(1.0)
+    assert br.allow()       # the one probe slot
+    assert not br.allow()   # bounded: no second concurrent probe
+    br.record_failure()     # probe failed -> open, cooldown restarts
+    assert br.state == "open"
+    clk.advance(0.6)
+    assert br.state == "open"  # restarted cooldown not elapsed
+    clk.advance(0.6)
+    assert br.state == "half_open"
+
+
+def test_breaker_error_rate_trip():
+    clk = _Clock()
+    br = CircuitBreaker(failures=100, error_rate=0.5, window=10,
+                        cooldown_s=1.0, now=clk)
+    # alternate fail/success: consecutive never accumulates, and the
+    # window is not full until the 10th sample, so the breaker holds
+    for _ in range(5):
+        br.record_failure()
+        br.record_success()
+    assert br.state == "closed"
+    # 11th sample evicts the oldest; window is now 5/10 failed >= 0.5
+    br.record_failure()
+    assert br.state == "open"
+
+
+def test_breaker_cancel_is_neutral():
+    clk = _Clock()
+    br = CircuitBreaker(failures=1, cooldown_s=1.0, now=clk)
+    br.record_failure()
+    clk.advance(1.5)
+    assert br.allow()       # half_open, slot claimed
+    assert not br.allow()
+    br.record_cancel()      # hedged loser: releases the slot only
+    assert br.state == "half_open"  # no verdict either way
+    assert br.allow()       # slot free again
+    br.record_success()
+    assert br.state == "closed"
+
+
+# ----------------------------------------------------------- hedging
+def test_hedge_budget_burst_plus_fraction():
+    hp = HedgePolicy(budget_frac=0.1, burst=2)
+    for _ in range(5):
+        hp.note_request()
+    # cap = burst 2 + 0.1 * 5 requests = 2.5 -> exactly two grants
+    grants = sum(hp.try_hedge() for _ in range(10))
+    assert grants == 2
+    # budget refills as real traffic flows
+    for _ in range(100):
+        hp.note_request()
+    assert hp.try_hedge()
+    c = hp.counters()
+    assert c["requests"] == 105 and c["hedges"] == 3
+
+
+def test_hedge_disabled_by_zero_budget():
+    hp = HedgePolicy(budget_frac=0.0, burst=8)
+    for _ in range(50):
+        hp.note_request()
+    assert not hp.try_hedge()
+
+
+def test_hedge_delay_quantile_and_floor():
+    hp = HedgePolicy(quantile=0.5, default_delay_s=0.07,
+                     min_delay_s=0.01)
+    assert hp.delay_s() == pytest.approx(0.07)  # cold ring: default
+    for v in (0.02, 0.04, 0.06, 0.08, 0.10):
+        hp.observe(v)
+    assert hp.delay_s() == pytest.approx(0.06)  # median of the ring
+    floor = HedgePolicy(min_delay_s=0.05, default_delay_s=0.001)
+    assert floor.delay_s() == pytest.approx(0.05)
+
+
+# ----------------------------------------------------------- backoff
+def test_full_jitter_bounds_and_schedule():
+    rng = random.Random(0)
+    for attempt in (1, 2, 3, 6):
+        ceil = backoff_delay(attempt, 0.05, 1.0)
+        for _ in range(50):
+            d = full_jitter_delay(attempt, 0.05, 1.0, rand=rng.random)
+            assert 0.0 <= d <= ceil
+    # degenerate rands pin the endpoints of the jitter interval
+    assert full_jitter_delay(3, 0.05, 1.0, rand=lambda: 1.0) == (
+        pytest.approx(backoff_delay(3, 0.05, 1.0)))
+    assert full_jitter_delay(1, 0.05, 1.0, rand=lambda: 0.0) == 0.0
+
+
+# ------------------------------------------------------- backend pool
+def _pool(n: int, **breaker_kw) -> BackendPool:
+    pool = BackendPool(
+        [f"http://127.0.0.1:{9000 + i}" for i in range(n)],
+        lambda url: CircuitBreaker(**breaker_kw),
+    )
+    for b in pool.backends:
+        pool.set_health(b, alive=True, ready=True)
+    return pool
+
+
+def test_pool_least_outstanding_and_exclusion():
+    pool = _pool(3)
+    first = [pool.acquire() for _ in range(3)]
+    # one slot each before anyone gets a second request
+    assert {b.url for b in first} == {b.url for b in pool.backends}
+    a = first[0]
+    pool.release(a)
+    assert pool.acquire() is a  # least outstanding wins
+    pool.release(a)
+    assert pool.acquire(exclude=(a,)) is not a
+
+
+def test_pool_breaker_and_readiness_gate():
+    pool = _pool(2, failures=1, cooldown_s=60.0)
+    b0, b1 = pool.backends
+    b0.breaker.record_failure()  # open: b0 admits nothing
+    for _ in range(4):
+        got = pool.acquire()
+        assert got is b1
+        pool.release(got)
+    pool.set_health(b1, alive=True, ready=False)
+    assert pool.acquire() is None  # b0 open, b1 not ready
+
+
+def test_pool_rejects_bad_urls():
+    with pytest.raises(ValueError):
+        BackendPool([], lambda u: CircuitBreaker())
+    with pytest.raises(ValueError):
+        # same backend after trailing-slash normalization
+        BackendPool(["http://h:1", "http://h:1/"],
+                    lambda u: CircuitBreaker())
+
+
+# ------------------------------------------------- gateway state machine
+def test_gateway_op_classes():
+    assert HEDGED_OPS <= IDEMPOTENT_OPS
+    assert not (FANOUT_OPS & IDEMPOTENT_OPS)  # load/swap/rollback never auto-retry
+
+
+def test_gateway_sheds_expired_deadline():
+    gw = Gateway(["http://127.0.0.1:1"])
+    status, resp, outcome = gw._single("score", {},
+                                       time.monotonic() - 1.0)
+    assert (status, outcome) == (503, "shed")
+    assert resp["error_kind"] == "shed" and resp["retry_after_s"] > 0
+
+
+def test_gateway_drain_rejects_new_work():
+    gw = Gateway(["http://127.0.0.1:1"])
+    assert not gw.draining
+    gw.begin_drain()
+    status, resp = gw.handle("score", {"rows": [[0.0]]})
+    assert status == 503 and resp["error_kind"] == "shutdown"
+    assert gw.drain(timeout_s=0.5)  # already idle -> immediate
+    assert gw.inflight() == 0
+    st = gw.status()
+    assert st["draining"] and not st["ok"]
+
+
+def test_gateway_unavailable_without_ready_backends():
+    # never probed -> nothing ready; retries=0 keeps this sleep-free
+    gw = Gateway(["http://127.0.0.1:1"], retries=0)
+    status, resp = gw.handle("score", {"rows": [[0.0]]})
+    assert status == 503 and resp["error_kind"] == "overloaded"
+    status, resp = gw.handle("load", {"path": "x"})  # fanout: none alive
+    assert status == 503 and resp["error_kind"] == "overloaded"
+
+
+def test_gateway_merged_metrics_exposition():
+    gw = Gateway(["http://127.0.0.1:1"], retries=0)
+    gw.handle("ping", {})  # moves the request counter (outcome counted)
+    merged = gw.merged_metrics()
+    assert merged["processes"] >= 1  # gateway's own snapshot, no backends
+    text = gw.merged_metrics_text()
+    assert "lgbmtpu_gateway_requests_total" in text
+    assert text.endswith("\n")
+
+
+# ------------------------------------------------------------ readiness
+class _FakeRegistry:
+    """Duck-typed registry: readiness() needs models()/queue_cap and
+    the optional health_probe attachment point only."""
+
+    def __init__(self, models=None, queue_cap=0, probe=None):
+        self._models = dict(models or {})
+        self.queue_cap = queue_cap
+        self.health_probe = probe
+
+    def models(self):
+        return dict(self._models)
+
+
+def test_readiness_verdict_matrix():
+    assert not readiness(_FakeRegistry())["ok"]  # no models
+    assert readiness(_FakeRegistry({"m": {}}))["ok"]
+
+    ev = threading.Event()
+    ev.set()
+    out = readiness(_FakeRegistry({"m": {}}), draining=ev)
+    assert not out["ok"] and out["reason"] == "draining"
+
+    # queue over the admission cap -> not ready (depth is the max over
+    # the gauge's entries, so cap relative to whatever earlier tests
+    # left behind)
+    depths = default_registry().snapshot().get(
+        "lgbmtpu_serve_queue_depth") or {}
+    base = int(max(depths.values(), default=0))
+    record_queue_depth("gwtest", base + 5)
+    try:
+        out = readiness(_FakeRegistry({"m": {}}, queue_cap=base + 5))
+        assert not out["ok"] and out["reason"] == "queue at admission cap"
+        record_queue_depth("gwtest", 0)
+        assert readiness(_FakeRegistry({"m": {}},
+                                       queue_cap=base + 6))["ok"]
+    finally:
+        record_queue_depth("gwtest", 0)
+
+    out = readiness(_FakeRegistry({"m": {}},
+                                  probe=lambda: {"healthy": False}))
+    assert not out["ok"] and out["reason"] == "loop heartbeat stale"
+
+
+def test_gateway_fault_sites_registered():
+    assert {"gw_connect", "gw_backend_5xx", "gw_slow_backend",
+            "gw_drain"} <= set(faultinject.SITES)
+
+
+# ======================================================================
+# slow / chaos: real sockets, real processes
+# ======================================================================
+@pytest.fixture(scope="module")
+def model_and_data(tmp_path_factory):
+    rs = np.random.RandomState(7)
+    X = rs.randn(200, 5).astype(np.float32)
+    y = (X @ rs.randn(5)).astype(np.float32)
+    bst = lgb.train(
+        {"objective": "regression", "verbosity": -1,
+         "min_data_in_leaf": 5, "num_leaves": 15},
+        lgb.Dataset(X, label=y, free_raw_data=False),
+        num_boost_round=5,
+    )
+    path = tmp_path_factory.mktemp("gwmodel") / "model.txt"
+    bst.save_model(str(path))
+    return str(path), X
+
+
+class _InProcBackend:
+    """A real serve_http backend inside the test process."""
+
+    def __init__(self, model_path: str):
+        self.registry = ModelRegistry(warmup=False)
+        self.registry.load("default", model_path)
+        self.httpd = serve_http(self.registry, 0, block=False)
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        host, port = self.httpd.server_address[:2]
+        self.url = f"http://{host}:{port}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.thread.join(timeout=10)
+
+
+def _post(url: str, op: str, body: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        f"{url}/v1/{op}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+@pytest.mark.slow
+def test_http_stalled_client_gets_408():
+    """Satellite hardening: a client that sends headers then stalls
+    mid-body hits the per-connection socket timeout and gets 408 —
+    the handler thread is freed, other clients keep being served."""
+    reg = ModelRegistry(warmup=False)
+    httpd = serve_http(reg, 0, block=False, socket_timeout_s=0.5)
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    host, port = httpd.server_address[:2]
+    try:
+        s = socket.create_connection((host, port), timeout=5)
+        try:
+            s.sendall(b"POST /v1/score HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Length: 4096\r\n\r\n")  # body never sent
+            s.settimeout(10)
+            status_line = s.recv(4096).split(b"\r\n", 1)[0]
+            assert b"408" in status_line
+        finally:
+            s.close()
+        # the stall did not wedge the server
+        with urllib.request.urlopen(f"http://{host}:{port}/healthz",
+                                    timeout=5) as r:
+            assert r.status == 200
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        th.join(timeout=10)
+
+
+@pytest.mark.slow
+def test_gateway_http_front_end_and_fanout(model_and_data):
+    model_path, X = model_and_data
+    backends = [_InProcBackend(model_path), _InProcBackend(model_path)]
+    gw = Gateway([b.url for b in backends], retries=2,
+                 backoff_base_s=0.01, health_interval_s=0.2,
+                 hedge_budget=0.0)
+    httpd = None
+    th = None
+    try:
+        gw.start(wait_ready_s=10.0)
+        assert gw.pool.counts() == (2, 2)
+        httpd = gateway_http(gw, 0, block=False)
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        host, port = httpd.server_address[:2]
+        url = f"http://{host}:{port}"
+        for path, want in (("/healthz", 200), ("/readyz", 200)):
+            with urllib.request.urlopen(url + path, timeout=10) as r:
+                assert r.status == want
+        st, resp = _post(url, "score", {"rows": X[:3].tolist(),
+                                        "deadline_ms": 30000})
+        assert st == 200 and resp["ok"] and len(resp["pred"]) == 3
+        # fan-out load to every alive backend, then score the new name
+        st, resp = _post(url, "load", {"path": model_path, "model": "m2"})
+        assert st == 200 and resp["ok"] and resp["fanout"] == 2
+        assert len(resp["results"]) == 2
+        st, resp = _post(url, "score", {"model": "m2",
+                                        "rows": X[:2].tolist()})
+        assert st == 200 and resp["ok"]
+        # single-pane /metrics: gateway families + backend families in
+        # one merged exposition
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "lgbmtpu_gateway_requests_total" in text
+        assert "lgbmtpu_gateway_backends_ready" in text
+        # quit stays local-only even through the gateway front end
+        try:
+            _post(url, "quit", {})
+            raise AssertionError("quit must be rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code in (400, 404)
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if th is not None:
+            th.join(timeout=10)
+        gw.stop()
+        for b in backends:
+            b.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_hedge_overtakes_stalled_attempt(model_and_data):
+    """gw_slow_backend stalls the primary attempt; the hedge fires at
+    the (default) trigger delay on the other backend and wins long
+    before the stall clears."""
+    model_path, X = model_and_data
+    backends = [_InProcBackend(model_path), _InProcBackend(model_path)]
+    gw = Gateway([b.url for b in backends], retries=2,
+                 backoff_base_s=0.01, health_interval_s=0.2,
+                 hedge_budget=1.0, hedge_default_delay_s=0.05,
+                 attempt_timeout_s=20.0)
+    try:
+        gw.start(wait_ready_s=10.0)
+        # warm BOTH backends (first score pays the predict compile)
+        for b in backends:
+            st, _ = _post(b.url, "score", {"rows": X[:2].tolist()})
+            assert st == 200
+        faultinject.arm("gw_slow_backend:1:delay:3.0")
+        t0 = time.monotonic()
+        st, resp = gw.handle("score", {"rows": X[:2].tolist(),
+                                       "deadline_ms": 15000})
+        dt = time.monotonic() - t0
+        assert st == 200 and resp["ok"], resp
+        assert dt < 2.0, f"hedge did not overtake the stall ({dt:.2f}s)"
+        assert gw.hedge.counters()["hedges"] >= 1
+    finally:
+        faultinject.disarm()
+        gw.stop()
+        for b in backends:
+            b.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_gateway_drain_waits_for_inflight(model_and_data):
+    """SIGTERM semantics without the signal: begin_drain sheds new
+    work immediately while drain() blocks until the stalled in-flight
+    request finishes — then the gateway is idle."""
+    model_path, X = model_and_data
+    backend = _InProcBackend(model_path)
+    gw = Gateway([backend.url], retries=0, health_interval_s=0.2,
+                 hedge_budget=0.0, attempt_timeout_s=20.0)
+    try:
+        gw.start(wait_ready_s=10.0)
+        st, _ = gw.handle("score", {"rows": X[:2].tolist()})
+        assert st == 200
+        faultinject.arm("gw_slow_backend:1:delay:1.0")
+        done = {}
+
+        def call():
+            done["r"] = gw.handle("score", {"rows": X[:2].tolist()})
+
+        th = threading.Thread(target=call, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 5.0
+        while gw.inflight() == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert gw.inflight() == 1
+        t0 = time.monotonic()
+        assert gw.drain(timeout_s=15.0)
+        waited = time.monotonic() - t0
+        assert gw.inflight() == 0
+        th.join(timeout=10)
+        assert done["r"][0] == 200  # the in-flight request finished
+        assert waited > 0.2  # drain actually waited for it
+        st, resp = gw.handle("score", {"rows": X[:2].tolist()})
+        assert st == 503 and resp["error_kind"] == "shutdown"
+    finally:
+        faultinject.disarm()
+        gw.stop()
+        backend.close()
+
+
+# ------------------------------------------------- subprocess backends
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_serve(model_path: str, port: int, extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(faultinject.ENV_VAR, None)
+    # logs to a spill file, not a PIPE: a filled pipe buffer would
+    # block the backend mid-test
+    logf = tempfile.TemporaryFile(mode="w+")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+         f"input_model={model_path}", f"serve_port={port}",
+         "serve_warmup=false", "device_type=cpu", "verbosity=-1",
+         *extra],
+        cwd=str(REPO), env=env, stdin=subprocess.DEVNULL,
+        stdout=logf, stderr=logf, text=True)
+    proc._test_log = logf  # closed by _stop_proc
+    return proc
+
+
+def _proc_log(proc) -> str:
+    logf = getattr(proc, "_test_log", None)
+    if logf is None:
+        return ""
+    logf.seek(0)
+    return logf.read()[-2000:]
+
+
+def _wait_ready(url: str, proc, timeout: float = 300.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"backend died rc={proc.returncode}:\n{_proc_log(proc)}")
+        try:
+            with urllib.request.urlopen(url + "/readyz", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:  # noqa: BLE001 — not up yet
+            pass
+        time.sleep(0.2)
+    raise AssertionError(f"backend at {url} never became ready")
+
+
+def _stop_proc(proc) -> None:
+    if proc.poll() is None:
+        proc.kill()
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        pass
+    logf = getattr(proc, "_test_log", None)
+    if logf is not None:
+        logf.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill9_backend_zero_client_failures(model_and_data):
+    """The ISSUE 17 chaos proof: kill -9 one of two real backend
+    processes under concurrent client load — no client sees a failure
+    (retry + exclusion absorb it), the victim's breaker opens, and
+    after a restart on the same port the breaker recovers through
+    half_open back to closed on real traffic."""
+    model_path, X = model_and_data
+    ports = [_free_port(), _free_port()]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    procs = [_spawn_serve(model_path, p) for p in ports]
+    gw = None
+    stop = threading.Event()
+    threads = []
+    try:
+        for u, p in zip(urls, procs):
+            _wait_ready(u, p)
+        gw = Gateway(urls, retries=3, backoff_base_s=0.02,
+                     backoff_cap_s=0.2, breaker_failures=1,
+                     breaker_cooldown_s=0.4, health_interval_s=0.5,
+                     hedge_budget=0.2, attempt_timeout_s=15.0)
+        transitions = []
+        orig = gw._on_breaker
+        gw._on_breaker = lambda name, old, new: (
+            transitions.append((name, old, new)), orig(name, old, new))
+        gw.start(wait_ready_s=15.0)
+        assert gw.pool.counts()[1] == 2
+
+        rows = X[:3].tolist()
+        # warm each backend directly: the first score pays the predict
+        # compile, which must not eat into the chaos phase's deadlines
+        for u in urls:
+            st, _ = _post(u, "score", {"rows": rows}, timeout=300)
+            assert st == 200
+        failures = []
+        flock = threading.Lock()
+
+        def client():
+            while not stop.is_set():
+                st, resp = gw.handle(
+                    "score", {"rows": rows, "deadline_ms": 30000})
+                if st != 200:
+                    with flock:
+                        failures.append((st, resp))
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # traffic flowing through both backends
+
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=30)
+        assert procs[0].returncode == -9
+        time.sleep(2.0)  # keep hammering the survivor
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert failures == [], failures[:3]
+
+        victim = gw.pool.backends[0]
+        victim_name = victim.name
+        # the raced/refused attempts tripped the breaker (failures=1),
+        # and/or the health loop pulled the backend from the pool
+        assert victim.breaker.state != "closed" or not victim.ready
+
+        # restart on the same port; health loop re-readies it and real
+        # traffic walks the breaker open -> half_open -> closed
+        procs[0] = _spawn_serve(model_path, ports[0])
+        _wait_ready(urls[0], procs[0])
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st, resp = gw.handle(
+                "score", {"rows": rows, "deadline_ms": 30000})
+            assert st == 200, resp
+            if victim.ready and victim.breaker.state == "closed":
+                break
+            time.sleep(0.05)
+        assert victim.breaker.state == "closed"
+        mine = [(o, n) for (b, o, n) in transitions if b == victim_name]
+        assert ("closed", "open") in mine
+        assert ("open", "half_open") in mine
+        assert ("half_open", "closed") in mine
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if gw is not None:
+            gw.stop()
+        for p in procs:
+            _stop_proc(p)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_backend_sigterm_drain_finishes_inflight(model_and_data):
+    """SIGTERM to a real task=serve backend while a request is stalled
+    in flight: the request still completes (server_close joins handler
+    threads) and the process exits 0 — the backend half of
+    tools/gateway_rolling.sh."""
+    model_path, X = model_and_data
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    # hit 1 = the warm request; hit 2 = the stalled in-flight request
+    # (/readyz and /healthz probes do not consume fault-plan hits)
+    proc = _spawn_serve(model_path, port,
+                        extra=("fault_plan=serve_request:2:delay:1.5",))
+    try:
+        _wait_ready(url, proc)
+        st, _ = _post(url, "score", {"rows": X[:2].tolist()},
+                      timeout=120)
+        assert st == 200
+
+        result = {}
+
+        def slow_call():
+            try:
+                result["resp"] = _post(url, "score",
+                                       {"rows": X[:2].tolist()},
+                                       timeout=60)
+            except Exception as e:  # noqa: BLE001 — reported below
+                result["error"] = repr(e)
+
+        th = threading.Thread(target=slow_call, daemon=True)
+        th.start()
+        time.sleep(0.5)  # request is in flight, stalled at the fault
+        proc.send_signal(signal.SIGTERM)
+        th.join(timeout=60)
+        assert "error" not in result, result
+        st, resp = result["resp"]
+        assert st == 200 and resp["ok"]
+        assert proc.wait(timeout=60) == 0  # clean exit after the drain
+    finally:
+        _stop_proc(proc)

@@ -9,6 +9,11 @@
 # (loop_promote:0:kill), delayed ingest (loop_ingest:0:delay:…), and a
 # poisoned-label microbatch — must leave a restart serving the last
 # PERSISTED promotion, in-process and for the SIGKILL'd task=loop CLI.
+# The serving-gateway matrix (tests/test_gateway.py) rides here too:
+# kill -9 a backend under concurrent load with ZERO client-visible
+# failures + breaker open -> half_open -> closed recovery, SIGTERM
+# drain finishing in-flight work, and hedging overtaking a stalled
+# attempt (gw_slow_backend delay plan).
 #
 # The fast chaos tests also run inside the tier-1 gate (they carry no
 # `slow` mark); this entry point runs the FULL chaos set, including the

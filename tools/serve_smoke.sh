@@ -75,9 +75,11 @@ assert err < 1e-5, f"serve/host mismatch: {err}"
 print(f"serve_smoke: OK ({len(rows)} rows scored, max |diff| {err:.2e})")
 EOF
 
-# HTTP transport: /healthz liveness + /metrics Prometheus exposition
-# (docs/OBSERVABILITY.md) — scrape after scoring and assert the
-# exposition carries the serving counters.
+# HTTP transport: /healthz liveness + /readyz readiness + /metrics
+# Prometheus exposition (docs/OBSERVABILITY.md) — scrape after scoring
+# and assert the exposition carries the serving counters. Liveness and
+# readiness are split endpoints (docs/RESILIENCE.md "Serving
+# gateway"): the gateway routes traffic on /readyz only.
 python - "$WORK" <<'EOF2'
 import json
 import socket
@@ -110,6 +112,11 @@ try:
             time.sleep(0.5)
     else:
         raise SystemExit("serve_http never became healthy")
+    # readiness: model loaded + queue under cap + heartbeat fresh
+    with urllib.request.urlopen(base + "/readyz", timeout=5) as r:
+        ready = json.loads(r.read())
+    assert r.status == 200 and ready["ok"], ready
+    assert ready["models"] >= 1, ready
     req = urllib.request.Request(
         base + "/v1/score",
         data=json.dumps({"rows": [[0.0] * 5, [1.0] * 5]}).encode(),
