@@ -40,6 +40,8 @@ Voting segment (needs a multi-device mesh, e.g.
 XLA_FLAGS=--xla_force_host_platform_device_count=8 on CPU):
 BENCH_SKIP_VOTING, BENCH_VOTING_TREES, BENCH_VOTING_EXACT_TREES,
 BENCH_VOTING_LEAVES, BENCH_VOTING_TOPK.
+Chunk-scan segment (tpu_chunk_scan=auto vs off, same run):
+BENCH_SKIP_CHUNK_SCAN, BENCH_CHUNK_TREES.
 """
 
 import importlib.util
@@ -193,6 +195,10 @@ def _final_json():
               "voting_trees_per_sec", "voting_exact_trees_per_sec",
               "voting_speedup_vs_exact", "voting_auc_valid",
               "voting_leaves", "voting_devices",
+              "chunk_scan_trees_per_sec", "chunk_scan_off_trees_per_sec",
+              "chunk_scan_speedup", "chunk_scan_dispatches",
+              "chunk_scan_off_dispatches", "chunk_scan_host_ms_per_tree",
+              "chunk_scan_off_host_ms_per_tree",
               "run_id", "run_manifest"):
         if k in _STATE:
             out[k] = _STATE[k]
@@ -415,7 +421,7 @@ def main() -> None:
     save_partial(stage="timed", warmup_s=round(compile_s, 2))
 
     # Callbacks replay at fused-loop chunk boundaries (engine chunk =
-    # _check_every = 50), so consecutive callback wall times within one
+    # _check_every = 64), so consecutive callback wall times within one
     # chunk are compressed; chunk-boundary deltas are REAL sync points.
     # Steady-state trees/s = trees between the first and last boundary
     # over the wall time between them — this excludes the one-time jit
@@ -423,7 +429,7 @@ def main() -> None:
     # served by the persistent cache). Both numbers are reported;
     # `value` is steady-state when >= 2 boundaries exist.
     def timed_train(run_params, n_trees, tag=""):
-        """One timed training run; returns (steady, total_tps, auc).
+        """One timed training run; returns (steady, total_tps, auc, bst).
 
         Steady-state = trees between the first and last chunk-boundary
         callback burst over the wall time between them (excludes the
@@ -476,9 +482,9 @@ def main() -> None:
             auc = round(float(roc_auc_score(yv, bst2.predict(Xv))), 5)
         except Exception:  # noqa: BLE001
             pass
-        return steady, total_tps, auc
+        return steady, total_tps, auc, bst2
 
-    steady, total_tps, auc = timed_train(params, trees)
+    steady, total_tps, auc, _ = timed_train(params, trees)
     save_partial(
         stage="scoring",
         trees_per_sec=round(steady if steady else total_tps, 4),
@@ -499,7 +505,8 @@ def main() -> None:
                        num_grad_quant_bins=4, quant_train_renew_leaf=True)
         save_partial(stage="quantized")
         try:
-            qsteady, qtotal, qauc = timed_train(qparams, qtrees, tag="quant ")
+            qsteady, qtotal, qauc, _ = timed_train(
+                qparams, qtrees, tag="quant ")
             save_partial(
                 quantized_trees_per_sec=round(qsteady or qtotal, 4),
                 quantized_total_trees_per_sec=round(qtotal, 4),
@@ -508,6 +515,43 @@ def main() -> None:
                 save_partial(quantized_auc_valid=qauc)
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] quantized segment failed: {e}\n")
+
+    # chunk-scan segment: the SAME training with rounds dispatched as
+    # C-round lax.scan chunks (tpu_chunk_scan=auto, the default) vs one
+    # executable launch per round (=off) — a same-run measurement of
+    # what evicting the host from the inner loop buys. Alongside
+    # trees/sec it reports the dispatch count (the probe the tests
+    # assert on: chunks, not rounds) and host ms spent inside
+    # fused_dispatch per tree; the device-side step math is identical
+    # on both sides by construction (bit-parity tested).
+    if not os.environ.get("BENCH_SKIP_CHUNK_SCAN"):
+        ctrees = int(os.environ.get("BENCH_CHUNK_TREES", min(trees, 30)))
+        save_partial(stage="chunk_scan")
+
+        def _host_ms_per_tree(b, n):
+            return round(1000.0 * b._gbdt._dispatch_host_s / max(n, 1), 3)
+
+        try:
+            csteady, ctotal, _, cbst = timed_train(
+                dict(params, tpu_chunk_scan="auto"), ctrees, tag="chunk ")
+            osteady, ototal, _, obst = timed_train(
+                dict(params, tpu_chunk_scan="off"), ctrees,
+                tag="chunk-off ")
+            ctps, otps = csteady or ctotal, osteady or ototal
+            save_partial(
+                chunk_scan_trees_per_sec=round(ctps, 4),
+                chunk_scan_off_trees_per_sec=round(otps, 4),
+                chunk_scan_speedup=(
+                    round(ctps / otps, 3) if otps else None),
+                chunk_scan_dispatches=cbst._gbdt.fused_dispatch_count,
+                chunk_scan_off_dispatches=obst._gbdt.fused_dispatch_count,
+                chunk_scan_host_ms_per_tree=_host_ms_per_tree(
+                    cbst, ctrees),
+                chunk_scan_off_host_ms_per_tree=_host_ms_per_tree(
+                    obst, ctrees),
+            )
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] chunk_scan segment failed: {e}\n")
 
     # third segment: voting-parallel (tree_learner=voting riding the
     # rounds grower) against the sequential exact oracle
@@ -533,13 +577,13 @@ def main() -> None:
             save_partial(stage="voting", voting_leaves=vleaves,
                          voting_devices=jax.device_count())
             try:
-                vsteady, vtotal, vauc = timed_train(
+                vsteady, vtotal, vauc, _ = timed_train(
                     vparams, vtrees, tag="voting ")
                 vtps = vsteady or vtotal
                 save_partial(voting_trees_per_sec=round(vtps, 4))
                 if vauc is not None:
                     save_partial(voting_auc_valid=vauc)
-                esteady, etotal, _ = timed_train(
+                esteady, etotal, _, _ = timed_train(
                     dict(vparams, tpu_growth_mode="exact"), etrees,
                     tag="voting-exact ")
                 etps = esteady or etotal

@@ -522,7 +522,41 @@ _RS_INT32 = dict(quant=True, levels=16, local_rows=2048)
 # per-shard exactness bound trips and the wire must fall back to psum
 _RS_OVERFLOW = dict(quant=True, levels=256, local_rows=131072)
 
+# chunk length traced for the fused_chunk_scan entry, and the second
+# length the C-invariance audit compares against. Both must be real
+# config.DEFAULT_CHUNK_LADDER rungs so the audited executables are the
+# ones training actually dispatches.
+_CHUNK_SCAN_C = 4
+_CHUNK_SCAN_C_ALT = 16
+
+
+def _trace_chunk_scan(length: int = _CHUNK_SCAN_C):
+    """One C-round fused chunk dispatch (boosting.trace_fused_chunk):
+    the whole boosting inner loop — gradients, growth, score updates,
+    device metrics — scanned on device. The mega-entry of ROADMAP item
+    2; budgets must NOT scale with C (scan body counted once)."""
+    from ..boosting import trace_fused_chunk
+
+    return trace_fused_chunk(length)
+
+
 ENTRIES: Dict[str, _Entry] = {
+    "fused_chunk_scan": _Entry(
+        _trace_chunk_scan,
+        lambda budget: [
+            has_prim("scan",
+                     "the C-round boosting loop is device control flow"),
+            no_host_callbacks(),
+            no_f64(),
+            lacks_prim("reduce_scatter",
+                       "single device; the chunk carries no mesh wire"),
+            within_budget(budget),
+        ],
+        "chunk-scan fused boosting dispatch (boosting.fused_dispatch): "
+        f"{_CHUNK_SCAN_C} rounds of gradients+growth+score+metrics as "
+        "one lax.scan — the host-evicted inner loop, held to the same "
+        "callback/f64/budget contracts as every other entry",
+    ),
     "rounds_quant_rs": _Entry(
         lambda: _trace_rounds_dp(**_RS_OK),
         lambda budget: [
@@ -814,6 +848,30 @@ def audit_faultinject() -> AuditResult:
     )
 
 
+# ------------------------------------------- chunk-scan C-invariance audit
+def audit_chunk_invariance() -> AuditResult:
+    """The scan body is traced ONCE: the chunk jaxpr's flattened eqn
+    count must be identical across ladder rungs (scan length is a jaxpr
+    param). Accidental unrolling — a Python loop over rounds, a
+    shape-dependent branch on the rung — would scale eqns with C and
+    silently void the committed fused_chunk_scan budgets, which are
+    pinned at C=%d and must cover every rung.""" % _CHUNK_SCAN_C
+    from ..boosting import trace_fused_chunk
+
+    a = summarize(trace_fused_chunk(_CHUNK_SCAN_C))
+    b = summarize(trace_fused_chunk(_CHUNK_SCAN_C_ALT))
+    ok = a.eqn_count == b.eqn_count
+    c = Contract(
+        "eqns_independent_of_C", ok,
+        f"{a.eqn_count} eqns at C={_CHUNK_SCAN_C} vs {b.eqn_count} at "
+        f"C={_CHUNK_SCAN_C_ALT}"
+        + ("" if ok else
+           " — the scan body unrolled; budgets no longer cover all "
+           "ladder rungs"),
+    )
+    return AuditResult("chunk_c_invariance", ok, [c], a.eqn_count)
+
+
 # ------------------------------------------------------------------ runner
 # entry traces are pure functions of checked-in shapes, and the strict
 # gate reads each one at least twice (jaxpr pass + cost pass, several
@@ -862,9 +920,9 @@ def load_budgets() -> Dict[str, int]:
 
 def run_audits(names: Optional[Sequence[str]] = None,
                update_budget: bool = False) -> List[AuditResult]:
+    _standalone = ("obj_fold_attrs", "faultinject", "chunk_c_invariance")
     if names is not None:
-        unknown = set(names) - set(ENTRIES) - {"obj_fold_attrs",
-                                               "faultinject"}
+        unknown = set(names) - set(ENTRIES) - set(_standalone)
         if unknown:
             # a typoed entry name must not pass vacuously ("no silent
             # caps" — same posture as within_budget failing on a
@@ -872,7 +930,7 @@ def run_audits(names: Optional[Sequence[str]] = None,
             raise KeyError(
                 f"unknown audit entr{'y' if len(unknown) == 1 else 'ies'} "
                 f"{sorted(unknown)}; known: "
-                f"{sorted(ENTRIES) + ['faultinject', 'obj_fold_attrs']}"
+                f"{sorted(ENTRIES) + sorted(_standalone)}"
             )
     budgets = load_budgets()
     out: List[AuditResult] = []
@@ -893,6 +951,8 @@ def run_audits(names: Optional[Sequence[str]] = None,
         out.append(audit_fold_attrs())
     if names is None or "faultinject" in (names or ()):
         out.append(audit_faultinject())
+    if names is None or "chunk_c_invariance" in (names or ()):
+        out.append(audit_chunk_invariance())
     if update_budget:
         _BUDGET_PATH.write_text(
             json.dumps(new_budgets, indent=2, sort_keys=True) + "\n"

@@ -38,8 +38,13 @@ from .tree import Tree, traverse_tree_bins
 # canonical per-round host phase names (docs/OBSERVABILITY.md): the
 # eager loops (fast/sync) emit the three phases each iteration; the
 # fused loop — whose phases live inside one jit — emits one span per
-# dispatched step. obs.tracing records these as trace-event spans and
-# jax.profiler traces carry the same names via jax.named_scope.
+# DISPATCH. Under chunk scanning (tpu_chunk_scan=auto, the default) a
+# dispatch is a C-round lax.scan, so the span covers the whole chunk;
+# _ObsHooks divides it by the dispatch's round count (the booster's
+# _last_dispatch_rounds) to keep per-round record durations. With
+# tpu_chunk_scan=off each dispatch is one round, as historically.
+# obs.tracing records these as trace-event spans and jax.profiler
+# traces carry the same names via jax.named_scope.
 ROUND_PHASES = (
     "round: gradients",
     "round: grow",
@@ -121,6 +126,86 @@ def _audit_fold_attrs(objective) -> None:
 class _EvalNames(NamedTuple):
     names: List[str]
     higher_better: List[bool]
+
+
+class _PendingChunk(NamedTuple):
+    """One chunk-scan dispatch awaiting readback: ``trees`` is a tuple
+    of K TreeArrays whose every field is stacked ``(C, ...)`` by the
+    scan. Only the first ``n_active`` rounds are real; the tail past
+    the dispatch's ``it_end`` is an algebraic no-op on device (zeroed
+    leaf values, frozen iteration counter) and is sliced off on the
+    host at materialize, never entering the model list."""
+
+    trees: Any
+    n_rounds: int
+    n_active: int
+
+
+# device_trees placeholder for rounds living inside a not-yet-fetched
+# _PendingChunk; _materialize() replaces it with (host TreeArrays,
+# None). Every consumer of device_trees content materializes first
+# (fused_truncate, rollback via the `models` property, refit/splice),
+# so a None read here is a loud bug, not a silent wrong answer.
+_PENDING_SLOT: Tuple[Any, Any] = (None, None)
+
+
+def _pick_chunk(rounds_left: int, ladder: Sequence[int]) -> int:
+    """Largest ladder rung that fits, else the smallest rung (the
+    masked-tail dispatch). Greedy decomposition over a fixed ladder
+    bounds distinct scan executables at len(ladder) for ANY round
+    count — the retrace-guard contract."""
+    for c in sorted(ladder, reverse=True):
+        if c <= rounds_left:
+            return c
+    return min(ladder)
+
+
+class _FusedProgram:
+    """Traced programs for one fused-step memo key: the raw step body,
+    its per-round jit, and lazily-built C-round lax.scan chunk jits
+    (one per ladder rung actually dispatched). Cached in
+    _FUSED_STEP_CACHE, so the memo key effectively grows the chunk
+    length through ``chunks`` — cv folds and repeated trains share the
+    scan executables exactly like they share the per-round step."""
+
+    def __init__(self, step_fn, donate):
+        import jax
+
+        self.step_fn = step_fn
+        self.step = jax.jit(step_fn, donate_argnums=donate)
+        self._donate = donate
+        self.chunks: Dict[int, Any] = {}
+
+    def chunk_body(self, length: int):
+        """Un-jitted C-round chunk callable: scans the per-round step,
+        stacking the K per-round tree pytrees to (C, ...) and the eval
+        rows to (C, E). Exposed un-jitted so the analysis suite can
+        make_jaxpr it (the `fused_chunk_scan` entry)."""
+        from jax import lax
+
+        step_fn = self.step_fn
+
+        def chunk(state, data):
+            def body(st, _):
+                st2, trees, eval_row = step_fn(st, data)
+                return st2, (trees, eval_row)
+
+            new_state, (trees, eval_mat) = lax.scan(
+                body, state, xs=None, length=length
+            )
+            return new_state, trees, eval_mat
+
+        return chunk
+
+    def chunk(self, length: int):
+        import jax
+
+        fn = self.chunks.get(length)
+        if fn is None:
+            fn = jax.jit(self.chunk_body(length),
+                         donate_argnums=self._donate)
+            self.chunks[length] = fn
+        return fn
 
 
 def _obj_grads(objective, score, it):
@@ -238,10 +323,23 @@ class GBDT:
         # "no splittable leaf" stop condition only every _check_every
         # iterations. DART/RF and leaf-renewal objectives need per-iter
         # host work and force the synchronous path.
-        self._pending: List[TreeArrays] = []
+        self._pending: List[Any] = []  # TreeArrays (per-round) / _PendingChunk
         self._pending_meta: List[Tuple[int, float, float]] = []  # (k, bias, shrinkage)
+        # dispatch-count probe: executable launches issued by
+        # fused_dispatch (one per chunk under chunk scanning, one per
+        # round with tpu_chunk_scan=off) + the host seconds spent
+        # issuing them — read by tests and bench.py's chunk_scan
+        # segment. _last_dispatch_rounds holds the round count of each
+        # dispatch in the most recent chunk so _ObsHooks can expand
+        # per-dispatch spans into per-round durations.
+        self.fused_dispatch_count = 0
+        self._dispatch_host_s = 0.0
+        self._last_dispatch_rounds: List[int] = []
         self._stopped = False
-        self._check_every = 50
+        # aligned to max(config.DEFAULT_CHUNK_LADDER) so a full driver
+        # chunk dispatches as ONE top-rung lax.scan (50 used to shred
+        # into 16+16+16+4 and the 64 rung never fired)
+        self._check_every = 64
         self._force_sync = False
         self._force_sync_reason: Optional[str] = None
         self._init_iters = 0  # loaded iterations under continued training
@@ -952,12 +1050,37 @@ class GBDT:
         from .timer import global_timer as _gt
 
         with _gt.scope("materialize host trees (readback)"):
-            host = jax.device_get(self._pending)
+            fetched = jax.device_get(self._pending)
         meta = self._pending_meta
         self._pending = []
         self._pending_meta = []
         K = self.num_class
         base = len(self._models)  # device_trees index of host[0]
+        # flatten chunk-scan dispatches into the per-class-tree stream
+        # the loop below expects: a _PendingChunk holds K TreeArrays
+        # stacked (C, ...) — slice out each LIVE round (masked tail
+        # rounds past it_end were never appended to device_trees/meta)
+        host: List[Any] = []
+        for item in fetched:
+            if isinstance(item, _PendingChunk):
+                for r in range(item.n_active):
+                    for a in item.trees:
+                        host.append(
+                            jax.tree.map(lambda x, _r=r: x[_r], a)
+                        )
+            else:
+                host.append(item)
+        # chunk dispatches park _PENDING_SLOT placeholders in
+        # device_trees; back-fill them with the host-sliced arrays so
+        # rollback paths (stop detection below, fused_truncate,
+        # rollback_one_iter) can traverse them. Re-wrap as jnp arrays:
+        # device_trees entries are contractually jax (set_leaf_output
+        # edits them with .at[].set, scoring restacks them).
+        for j, a in enumerate(host):
+            if self.device_trees[base + j] is _PENDING_SLOT:
+                self.device_trees[base + j] = (
+                    jax.tree.map(jnp.asarray, a), None
+                )
         for i0 in range(0, len(host), K):
             group = host[i0 : i0 + K]
             if all(int(a.num_nodes) == 0 for a in group):
@@ -1423,6 +1546,19 @@ class GBDT:
             it = state["it"]
             shrink = state["shrink"]
             init_vec = state["init"]
+            # chunk-scan activity mask: a round is live unless the
+            # no-splittable-leaf stop already fired (`stopped`, sticky)
+            # or it lies past this dispatch's round budget (`it_end`,
+            # the masked tail of a ladder-rung scan). Inactive rounds
+            # are algebraic no-ops — zeroed leaf values freeze every
+            # score and `it` stops advancing, so RNG streams and state
+            # re-align bit-exactly with the per-round loop at the next
+            # dispatch boundary.
+            stopped = state["stopped"]
+            active = jnp.logical_and(
+                jnp.logical_not(stopped), it < data["it_end"]
+            )
+            actf = active.astype(jnp.float32)
             s_for_grad = score if K > 1 else score[0]
             # fold-varying objective attributes arrive as args: rebind
             # the traced values around the gradient call (restored right
@@ -1449,6 +1585,7 @@ class GBDT:
             hess = jnp.reshape(h, (K, -1)).astype(jnp.float32)
             valid_mask = data["valid"]
             trees = []
+            grew = []  # per-class split indicators (pre-mask)
             for k in range(K):
                 gk, hk = grad[k], hess[k]
                 mask, gk, hk = strategy.sample(
@@ -1465,7 +1602,12 @@ class GBDT:
                     gk, hk, mask, feat_mask, valid_mask, it, k,
                     bins=data["bins"], tables=data["tables"],
                 )
-                ok = (arrays.num_nodes > 0).astype(jnp.float32)
+                grew.append(arrays.num_nodes > 0)
+                # `actf` folds the activity mask in: post-stop / masked-
+                # tail rounds store zeroed leaf values (ok=0), so every
+                # score update and rollback subtraction below is an
+                # exact 0.0 and the carried state stays frozen
+                ok = (arrays.num_nodes > 0).astype(jnp.float32) * actf
                 if renew_alpha is not None:
                     # percentile leaf refit on device (RenewTreeOutput,
                     # gbdt.cpp:418 — before shrinkage, in-bag rows only)
@@ -1517,12 +1659,25 @@ class GBDT:
                     jnp.sqrt(jnp.sum(hess * hess)),
                 ])
                 eval_row = jnp.concatenate([eval_row, gh_row])
+            # the reference's stop condition (no class-tree could split,
+            # gbdt.cpp:429-452) carried as a sticky device mask: once an
+            # ACTIVE round grows K stumps, every later round in this and
+            # any subsequent chunk is a no-op. `it` advances only on
+            # active rounds so the fold_in(seed, it*K+k) RNG streams of
+            # masked tail rounds are never consumed — the next chunk
+            # replays them bit-exactly as live rounds.
+            all_stump = jnp.logical_not(
+                jnp.any(jnp.stack(grew))
+            )
             new_state = {
                 "score": score,
                 "vscores": vscores,
-                "it": it + 1,
+                "it": it + active.astype(jnp.int32),
                 "shrink": shrink,
                 "init": init_vec,
+                "stopped": jnp.logical_or(
+                    stopped, jnp.logical_and(active, all_stump)
+                ),
             }
             return new_state, tuple(trees), eval_row
 
@@ -1544,6 +1699,11 @@ class GBDT:
             },
             "renew_w": renew_w,
             "eval_arrs": eval_arrs,
+            # absolute round limit for the current dispatch; overwritten
+            # by fused_dispatch before every launch. Rides `data` (not
+            # the carry) so a ladder-rung scan of ANY requested length
+            # reuses one executable — the masked tail handles the rest.
+            "it_end": jnp.int32(0),
         }
         if self.dev.get("bundle") is not None:
             self._f_data["tables"]["bundle"] = self.dev["bundle"]
@@ -1569,7 +1729,8 @@ class GBDT:
             cached = _FUSED_STEP_CACHE.get(key)
             if cached is not None:
                 _FUSED_STEP_CACHE.move_to_end(key)  # LRU touch
-                self._f_step = cached
+                self._f_program = cached
+                self._f_step = cached.step
                 return
         # donate the loop state on accelerators (scores are the big
         # per-iteration buffers); NOT on CPU — XLA:CPU donation has
@@ -1578,9 +1739,10 @@ class GBDT:
         # the documented VERDICT r5 item 5 fragility), and CPU runs are
         # tests/CI where the extra score copy is noise
         donate = () if jax.default_backend() == "cpu" else (0,)
-        self._f_step = jax.jit(step, donate_argnums=donate)
+        self._f_program = _FusedProgram(step, donate)
+        self._f_step = self._f_program.step
         if key is not None:
-            _FUSED_STEP_CACHE[key] = self._f_step
+            _FUSED_STEP_CACHE[key] = self._f_program
             while len(_FUSED_STEP_CACHE) > _FUSED_STEP_CACHE_MAX:
                 _FUSED_STEP_CACHE.popitem(last=False)
 
@@ -1612,29 +1774,91 @@ class GBDT:
             "it": jnp.int32(self.iter_),
             "shrink": jnp.float32(self.shrinkage_rate),
             "init": jnp.asarray(np.asarray(init_scores, np.float32)),
+            "stopped": jnp.asarray(False),
         }
-        self._f_evals: List[Any] = []
+        # entries are (device rows, n_active): a per-round (E,) row with
+        # n_active=None, or a chunk's (C, E) stack whose first n_active
+        # rows are live — fused_collect slices on the host
+        self._f_evals: List[Tuple[Any, Optional[int]]] = []
+        self._last_dispatch_rounds = []
 
     def fused_dispatch(self, n: int) -> None:
-        """Dispatch n fused iterations without any host synchronization."""
-        for _ in range(n):
-            # per-round span: covers only the async DISPATCH (device
-            # time lands in "fused collect"); the in-jit phases show up
-            # in jax.profiler traces under their named_scope names
-            with _gt.scope(FUSED_ROUND_PHASE):
-                self._fstate, trees, eval_row = self._f_step(
-                    self._fstate, self._f_data
-                )
-            for k, arrays in enumerate(trees):
-                self.device_trees.append((arrays, None))
-                self._pending.append(arrays)
-                self._pending_meta.append(
-                    (k, self._init_scores[k] if self.iter_ == 0 else 0.0,
-                     self.shrinkage_rate)
-                )
-            self._f_evals.append(eval_row)
-            self.iter_ += 1
-        self._record_collective_wire(n * self.num_class)
+        """Dispatch n fused iterations without any host synchronization.
+
+        Default (``tpu_chunk_scan=auto``): n is greedily decomposed over
+        the ``config.DEFAULT_CHUNK_LADDER`` rungs, largest-first, and
+        each rung launches ONE jitted ``lax.scan`` of the per-round step
+        — one executable launch and one host pytree unpack per CHUNK
+        instead of per round, the all-device inner loop of ROADMAP item
+        2. A remainder shorter than the smallest rung still dispatches
+        that rung: rounds at or past ``it_end`` are algebraic no-ops on
+        device (zeroed leaf values, frozen scores/``it``) and their
+        stacked outputs are sliced off at materialize, so truncation is
+        exact and no chunk size ever retraces. ``tpu_chunk_scan=off``
+        keeps the historical one-dispatch-per-round loop as the
+        bit-parity baseline.
+
+        The ``FUSED_ROUND_PHASE`` span covers one DISPATCH (a whole
+        chunk by default) and only its async host cost — device time
+        lands in "fused collect"; per-dispatch round counts land in
+        ``_last_dispatch_rounds`` so the flight recorder can apportion
+        the span across rounds.
+        """
+        import time as _time
+
+        import jax.numpy as jnp
+
+        if n <= 0:
+            return
+        K = self.num_class
+        t0 = _time.perf_counter()
+        self._last_dispatch_rounds = []
+        self._f_data["it_end"] = jnp.int32(self.iter_ + n)
+        if getattr(self.config, "tpu_chunk_scan", "auto") == "off":
+            for _ in range(n):
+                with _gt.scope(FUSED_ROUND_PHASE):
+                    self._fstate, trees, eval_row = self._f_step(
+                        self._fstate, self._f_data
+                    )
+                self.fused_dispatch_count += 1
+                self._last_dispatch_rounds.append(1)
+                for k, arrays in enumerate(trees):
+                    self.device_trees.append((arrays, None))
+                    self._pending.append(arrays)
+                    self._pending_meta.append(
+                        (k, self._init_scores[k] if self.iter_ == 0 else 0.0,
+                         self.shrinkage_rate)
+                    )
+                self._f_evals.append((eval_row, None))
+                self.iter_ += 1
+        else:
+            from .config import DEFAULT_CHUNK_LADDER
+
+            left = n
+            while left > 0:
+                length = _pick_chunk(left, DEFAULT_CHUNK_LADDER)
+                n_act = min(length, left)
+                chunk_fn = self._f_program.chunk(length)
+                with _gt.scope(FUSED_ROUND_PHASE):
+                    self._fstate, trees, eval_mat = chunk_fn(
+                        self._fstate, self._f_data
+                    )
+                self.fused_dispatch_count += 1
+                self._last_dispatch_rounds.append(n_act)
+                self._pending.append(_PendingChunk(trees, length, n_act))
+                for _r in range(n_act):
+                    for k in range(K):
+                        self.device_trees.append(_PENDING_SLOT)
+                        self._pending_meta.append(
+                            (k,
+                             self._init_scores[k] if self.iter_ == 0 else 0.0,
+                             self.shrinkage_rate)
+                        )
+                    self.iter_ += 1
+                self._f_evals.append((eval_mat, n_act))
+                left -= n_act
+        self._dispatch_host_s += _time.perf_counter() - t0
+        self._record_collective_wire(n * K)
         # keep canonical score handles current (no sync; handle reassign)
         self.train.score = self._fstate["score"]
         for vs, s in zip(self.valids, self._fstate["vscores"]):
@@ -1645,15 +1869,25 @@ class GBDT:
         Returns per-iteration evaluation tuple lists (possibly truncated
         when the no-splittable-leaf stop condition fired mid-chunk)."""
         import jax
-        import jax.numpy as jnp
 
         n_iter_before = len(self._models) // self.num_class
         evals = self._f_evals
         self._f_evals = []
+        rows: List[np.ndarray] = []
         if evals:
-            mat = np.asarray(jax.device_get(jnp.stack(evals)))
-        else:
-            mat = np.zeros((0, 0), np.float32)
+            # ONE batched readback over per-round (E,) rows and chunked
+            # (C, E) stacks alike; chunk stacks are host-sliced to their
+            # live rounds (the masked tail never produced real evals)
+            fetched = jax.device_get([e for e, _na in evals])
+            for got, (_e, n_act) in zip(fetched, evals):
+                got = np.asarray(got)
+                if got.ndim == 1:
+                    rows.append(got)
+                else:
+                    rows.extend(got[:n_act])
+        mat = (
+            np.stack(rows) if rows else np.zeros((0, 0), np.float32)
+        )
         self._materialize()
         n_iter_after = len(self._models) // self.num_class
         produced = n_iter_after - n_iter_before
@@ -2492,6 +2726,58 @@ def splice_continued(base: GBDT, delta: GBDT) -> GBDT:
     base.models = combined  # setter also clears any pending device trees
     base.iter_ = len(combined) // base.num_class
     return base
+
+
+# ---------------------------------------------------------------------------
+# analysis-suite tracing hooks (analysis/jaxpr_audit `fused_chunk_scan`)
+
+_TRACE_CHUNK_GBDT: Optional["GBDT"] = None
+_TRACE_CHUNK_JAXPRS: Dict[int, Any] = {}
+
+
+def _trace_chunk_gbdt() -> "GBDT":
+    """Tiny synthetic regression booster shared by the chunk-scan trace
+    entries (one per C). Pinned to the rounds grower so the audited
+    program is the TPU-default scan body, and kept minuscule — the
+    entry's eqn/cost budgets gate structure, not scale."""
+    global _TRACE_CHUNK_GBDT
+    if _TRACE_CHUNK_GBDT is None:
+        from .basic import Booster, Dataset
+
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 8).astype(np.float64)
+        y = x @ rs.randn(8) + 0.1 * rs.randn(256)
+        ds = Dataset(x, label=y, free_raw_data=False,
+                     params={"min_data_in_leaf": 4, "max_bin": 15})
+        bst = Booster(
+            params={
+                "objective": "regression", "num_leaves": 7,
+                "min_data_in_leaf": 4, "max_bin": 15,
+                "tpu_growth_mode": "rounds", "verbosity": -1,
+            },
+            train_set=ds,
+        )
+        g = bst._gbdt
+        g.fused_start(track_train=False)
+        _TRACE_CHUNK_GBDT = g
+    return _TRACE_CHUNK_GBDT
+
+
+def trace_fused_chunk(length: int = 4):
+    """ClosedJaxpr of one C-round chunk-scan dispatch (the fused mega-
+    entry). The scan body is traced ONCE regardless of C — length is a
+    jaxpr param — so the analysis C-invariance audit can assert equal
+    eqn counts across two lengths to catch accidental unrolling, and
+    the committed eqn/flops/bytes budgets must not scale with C."""
+    got = _TRACE_CHUNK_JAXPRS.get(length)
+    if got is None:
+        import jax
+
+        g = _trace_chunk_gbdt()
+        chunk = g._f_program.chunk_body(length)
+        got = jax.make_jaxpr(chunk)(g._fstate, g._f_data)
+        _TRACE_CHUNK_JAXPRS[length] = got
+    return got
 
 
 def create_boosting(config: Config, train_set: Optional[BinnedDataset]) -> GBDT:

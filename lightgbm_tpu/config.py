@@ -34,6 +34,18 @@ _frac = lambda v: 0.0 < v <= 1.0
 # and serving.dispatch.DEFAULT_BUCKETS cannot drift.
 DEFAULT_SERVE_BUCKETS = (16, 64, 256, 1024, 4096)
 
+# Chunk ladder for the fused boosting loop's lax.scan dispatches
+# (boosting.fused_dispatch): a dispatch of n rounds is greedily
+# decomposed over these rung lengths, largest-first, so any
+# num_boost_round / early-stop chunk size compiles at most len(ladder)
+# scan executables — same pow2-ladder idiom as the serve buckets
+# above. A tail shorter than the smallest rung still dispatches the
+# smallest rung; rounds past the `it_end` limit are masked on device
+# and sliced off at materialize, so truncation stays exact without a
+# bespoke (retracing) chunk length. Canonical HERE (config is a leaf
+# module) so boosting and the analysis suite cannot drift.
+DEFAULT_CHUNK_LADDER = (4, 16, 64)
+
 _PARAMS: Dict[str, _P] = {
     # ---- Core parameters (config.h "Core Parameters") ----
     "config": ("", str, ("config_file",), None),
@@ -219,6 +231,15 @@ _PARAMS: Dict[str, _P] = {
     "tpu_hist_dtype": ("auto", str, ("hist_dtype",),
                        lambda v: v in ("auto", "float32", "bf16x2",
                                        "int16", "int8")),
+    # fused-loop round chunking: "auto" (default) = dispatch boosting
+    # rounds as C-round lax.scan chunks over the DEFAULT_CHUNK_LADDER
+    # (one executable launch per chunk — the all-device inner loop);
+    # "off" = the historical one-jit-dispatch-per-round loop, kept as
+    # the bit-parity baseline for tests and the bench.py `chunk_scan`
+    # segment. Both paths share one traced step body, so models and
+    # eval records are bit-identical either way.
+    "tpu_chunk_scan": ("auto", str, (),
+                       lambda v: v in ("auto", "off")),
     # USE_DEBUG split validation (serial_tree_learner.h:174 CheckSplit):
     # recompute leaf counts/hessian sums from the partition each
     # iteration and fatal on drift; forces the sync loop

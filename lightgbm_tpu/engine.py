@@ -96,12 +96,24 @@ class _ObsHooks:
     # ------------------------------------------------------------------
     def start_chunk(self, n_records: int, chunk_seconds: float) -> None:
         """Fused chunk boundary: drain the span sink once and slice the
-        per-round ``round: fused step`` spans out; chunk-level scopes
-        (dispatch/collect/materialize) ride the chunk's first record."""
+        ``round: fused step`` spans out; chunk-level scopes
+        (dispatch/collect/materialize) ride the chunk's first record.
+
+        One span covers one DISPATCH — a whole C-round lax.scan under
+        chunk scanning — so the booster's ``_last_dispatch_rounds``
+        apportions each span evenly across its rounds: records keep a
+        per-round duration either way."""
         from .boosting import FUSED_ROUND_PHASE
 
         drained = self.recorder.drain_phases()
-        self._step_durs = drained.pop(FUSED_ROUND_PHASE, [])
+        spans = drained.pop(FUSED_ROUND_PHASE, [])
+        per_dispatch = getattr(self._gbdt, "_last_dispatch_rounds", None)
+        if not per_dispatch:
+            per_dispatch = [1] * len(spans)
+        durs: List[float] = []
+        for dur, n_rounds in zip(spans, per_dispatch):
+            durs.extend([dur / max(n_rounds, 1)] * n_rounds)
+        self._step_durs = durs
         self._chunk_phases = {
             k: round(sum(v), 6) for k, v in drained.items()
         }
@@ -437,9 +449,11 @@ def train(
         )
     try:
         if use_fused:
-            # fused device loop: one jit dispatch per iteration, zero host
-            # syncs; evals fetched per chunk and callbacks replayed in order
-            # (identical per-iteration semantics, delivered late)
+            # fused device loop: rounds dispatched as C-round lax.scan
+            # chunks (one executable launch per ladder rung;
+            # boosting.fused_dispatch), zero host syncs; evals fetched
+            # per chunk and callbacks replayed in order (identical
+            # per-iteration semantics, delivered late)
             gbdt = booster._gbdt
             gbdt.train.name = booster._train_data_name
             gbdt.fused_start(track_train=valid_contain_train)

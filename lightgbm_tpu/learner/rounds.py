@@ -158,7 +158,18 @@ def grow_tree_rounds(
     sums stay exact integers (bf16 products, f32 accumulation) and are
     multiplied by the scales once per histogram before split search —
     the reference's int-histogram arithmetic (gradient_discretizer.cpp,
-    feature_histogram.hpp:1062) mapped onto the MXU."""
+    feature_histogram.hpp:1062) mapped onto the MXU.
+
+    Trace-safety contract: this function is the workhorse inside the
+    fused step, which since round 18 is the BODY of a `lax.scan` chunk
+    (boosting.fused_dispatch, tpu_chunk_scan). Everything here must
+    therefore stay traceable with abstract operands — no host branching
+    on data values (python `if` only on static spec/params fields), no
+    `.item()`/`float()` coercions, shapes independent of the round
+    index. The per-round variation (bagging masks, rng_key, gh scales)
+    arrives as traced ARGUMENTS; violating this turns one chunk
+    executable into a retrace per round and trips
+    analysis/retrace.py's guard in tests/test_chunk_scan.py."""
     L = spec.num_leaves
     B = spec.num_bins
     G, N = bins_fm.shape  # G = device columns (bundles when spec.efb)

@@ -175,7 +175,9 @@ def test_latency_stats_reset_and_shared_ring():
 # ----------------------------------------------------------------- tracing
 def test_trace_export_fused_round_spans(rng, tmp_path):
     """Chrome trace-event JSON loads and carries one fused-round span
-    per boosting iteration (the fused path's per-round phase)."""
+    per DISPATCH: a 4-round training is one chunk-scan launch (one
+    span covering all 4 rounds); with tpu_chunk_scan=off it
+    degenerates to the historical one-span-per-round stream."""
     X = rng.randn(400, 4)
     y = (X[:, 0] > 0).astype(np.float32)
     path = tmp_path / "trace.json"
@@ -191,12 +193,22 @@ def test_trace_export_fused_round_spans(rng, tmp_path):
         assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
         assert "pid" in e and "tid" in e and e["name"]
     fused = [e for e in spans if e["name"] == boosting.FUSED_ROUND_PHASE]
-    assert len(fused) == 4
+    assert len(fused) == 1  # 4 rounds = one chunk dispatch
     # the JSONL log carries the same events one-per-line
     lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
     assert sum(1 for e in lines
-               if e.get("name") == boosting.FUSED_ROUND_PHASE) == 4
+               if e.get("name") == boosting.FUSED_ROUND_PHASE) == 1
     assert rec.events()  # recorder still readable after export
+    # per-round dispatch keeps the one-span-per-round stream
+    path2 = tmp_path / "trace_off.json"
+    with tracing.tracing(chrome_path=str(path2)):
+        _train({"objective": "binary", "num_leaves": 7,
+                "tpu_chunk_scan": "off"}, X, y, rounds=4)
+    data2 = json.loads(path2.read_text())
+    fused2 = [e for e in data2["traceEvents"]
+              if e.get("ph") == "X"
+              and e["name"] == boosting.FUSED_ROUND_PHASE]
+    assert len(fused2) == 4
 
 
 def test_trace_eager_path_has_every_round_phase(rng):
