@@ -9,8 +9,10 @@ contract; the default mode reports and exits 0.
 Budget maintenance:
   --update-budget     rewrite jaxpr_budget.json (+25% headroom)
   --refresh-budgets   rewrite cost_budget.json (+25% headroom on cost
-                      metrics, EXACT wire bytes) and print an old->new
-                      diff for review
+                      metrics, EXACT wire bytes), bench_budget.json,
+                      and scale_budget.json (EXACT per-rung pins over
+                      the full D-ladder), printing an old->new diff
+                      of each for review
 
 The jax-backed audits need a multi-device CPU mesh; this entry point
 forces `jax_platforms=cpu` with 8 virtual devices (same as
@@ -126,6 +128,21 @@ def main(argv=None) -> int:
             failed |= not gate.ok
             if not gate.ok:
                 print(gate.format())
+            # scaling-contract pins too (full D-ladder, exact)
+            from .scale_audit import (
+                format_scale_diff,
+                refresh_scale_budget,
+                run_scale_audits,
+            )
+
+            sold, snew = refresh_scale_budget()
+            print("scale_budget.json updated:")
+            print(format_scale_diff(sold, snew))
+            sresults = run_scale_audits()
+            failed |= not all(r.ok for r in sresults)
+            for r in sresults:
+                if not r.ok:
+                    print(r.format())
         if failed:
             print("analysis: FAIL (budgets updated, but contracts are "
                   "red)" if args.strict else

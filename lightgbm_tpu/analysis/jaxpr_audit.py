@@ -230,28 +230,46 @@ def audit_jaxpr(closed, contracts: Sequence[ContractFn],
 
 
 # ---------------------------------------------------------------- entries
-def _mesh8():
+# the forced host platform every audit mesh is carved from (the ONE
+# place the XLA_FLAGS bootstrap size is declared — __main__ and
+# tests/conftest.py both force this count before jax initializes)
+HOST_DEVICE_COUNT = 8
+
+
+def _mesh(n: int = HOST_DEVICE_COUNT, axis_name: str = "data"):
+    """1-D audit mesh over the first `n` of the forced 8 host CPU
+    devices — sub-meshes are how scale_audit re-traces every
+    mesh-bearing entry at the D ∈ {1, 2, 4, 8} ladder without touching
+    the backend bootstrap. Loud error below n devices: a silently
+    smaller mesh would re-pin every scaling budget at the wrong D."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
     devs = jax.devices()
-    if len(devs) < 2:
+    if len(devs) < n:
         raise RuntimeError(
-            "jaxpr audit needs a multi-device mesh; run under "
-            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
-            "(python -m lightgbm_tpu.analysis sets this up)"
+            f"jaxpr audit needs a {n}-device mesh but the backend has "
+            f"{len(devs)}; run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={HOST_DEVICE_COUNT} "
+            "(python -m lightgbm_tpu.analysis and tests/conftest.py "
+            "both set this up)"
         )
-    return Mesh(np.asarray(devs), ("data",))
+    return Mesh(np.asarray(devs[:n]), (axis_name,))
 
 
 def _trace_rounds_dp(quant: bool, levels: int, local_rows: int,
-                     voting_k: int = 0):
+                     voting_k: int = 0,
+                     n_devices: int = HOST_DEVICE_COUNT):
     """Abstract shard_map trace of the rounds grower over the data
     mesh — the exact wiring DataParallelGrower builds (shapes only; no
     arrays exist, so `local_rows` can model pod scale for free).
     voting_k>0 turns on the per-round GlobalVoting election
-    (tree_learner=voting): only the elected columns cross the mesh."""
+    (tree_learner=voting): only the elected columns cross the mesh.
+    `n_devices` carves a sub-mesh of the forced host platform; LOCAL
+    rows are held fixed so global rows scale with the mesh — the
+    weak-scaling axis the scale auditor's wire laws are written
+    against."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -264,7 +282,7 @@ def _trace_rounds_dp(quant: bool, levels: int, local_rows: int,
         shard_map_compat,
     )
 
-    mesh = _mesh8()
+    mesh = _mesh(n_devices)
     n = int(mesh.devices.size)
     L, B, G = 31, 64, 8
     N = local_rows * n
@@ -482,6 +500,65 @@ def _trace_serving_contrib():
     )(tables, ctables, mk((N, F), jnp.float32), mk((T,), jnp.float32))
 
 
+def _trace_feature_parallel(n_devices: int = HOST_DEVICE_COUNT):
+    """Abstract shard_map trace of the feature-parallel flat grower
+    over a ("feature",) mesh — the exact wiring FeatureParallelGrower
+    builds (parallel/feature_parallel.py): rows replicated, the bin
+    matrix and per-feature tables sharded on the feature axis, split
+    records all-gathered (SyncUpGlobalBestSplit) and the winning
+    shard's per-row decision broadcast with one psum. 16 features pad
+    evenly onto every rung of the D ladder."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..config import Config
+    from ..learner.grower import GrowerSpec, grow_tree, make_split_params
+    from ..parallel.data_parallel import (
+        _tree_arrays_structure,
+        shard_map_compat,
+    )
+
+    mesh = _mesh(n_devices, axis_name="feature")
+    L, B, F, N = 15, 64, 16, 512
+    spec = GrowerSpec(num_leaves=L, num_bins=B, max_depth=-1,
+                      partition="flat", feature_axis="feature",
+                      rounds_slots=0, has_cat=False)
+    params = make_split_params(Config({}))
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+
+    def fn(bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+           feat_mask, params, valid):
+        tree, row_leaf = grow_tree(
+            bins, nan_bin, num_bins, mono, is_cat, grad, hess, mask,
+            feat_mask, params, spec, valid=valid,
+        )
+        tree = jax.tree.map(
+            lambda a: jax.lax.pmean(a, "feature")
+            if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            tree,
+        )
+        return tree, row_leaf
+
+    fshard, rep = P("feature"), P()
+    sm = shard_map_compat(
+        fn, mesh=mesh,
+        in_specs=(P("feature", None), fshard, fshard, fshard, fshard,
+                  rep, rep, rep, fshard, rep, rep),
+        out_specs=(
+            jax.tree.map(lambda _: rep, _tree_arrays_structure(spec)),
+            rep,
+        ),
+        check_vma=False,
+    )
+    return jax.make_jaxpr(sm)(
+        mk((F, N), jnp.int32), mk((F,), jnp.int32), mk((F,), jnp.int32),
+        mk((F,), jnp.int32), mk((F,), jnp.bool_), mk((N,), jnp.float32),
+        mk((N,), jnp.float32), mk((N,), jnp.float32), mk((F,), jnp.bool_),
+        params, mk((N,), jnp.float32),
+    )
+
+
 def _trace_online_holdout():
     """Online promotion gate holdout evaluator (online/gate.py):
     auc + binary_logloss DeviceEvalSet over a 256-row shard with
@@ -502,6 +579,11 @@ class _Entry(NamedTuple):
     # entry contains pallas kernels: the cost auditor must trace it
     # under the pallas interpreter to compile on the CPU backend
     pallas_interpret: bool = False
+    # mesh-bearing entries: builder parameterized by device count, so
+    # scale_audit (Pass 7) can re-trace the same wiring at the
+    # D ∈ {1, 2, 4, 8} ladder. `builder` stays the full-mesh (D=8)
+    # trace every other pass reads; build_entry shares the memo.
+    mesh_builder: Optional[Callable[[int], Any]] = None
 
 
 # the quantized data-parallel histogram wire dtype (reference halves
@@ -570,6 +652,7 @@ ENTRIES: Dict[str, _Entry] = {
         "quantized data-parallel grower inside the exactness bounds: "
         f"{QUANT_WIRE_DTYPE} reduce-scatter wire end to end",
         wire_dtype=QUANT_WIRE_DTYPE,
+        mesh_builder=lambda d: _trace_rounds_dp(**_RS_OK, n_devices=d),
     ),
     "rounds_quant_rs_int32": _Entry(
         lambda: _trace_rounds_dp(**_RS_INT32),
@@ -584,6 +667,7 @@ ENTRIES: Dict[str, _Entry] = {
         "quantized grower past the int16 bound but inside int32 "
         "exactness: wire steps down to int32, not psum",
         wire_dtype="int32",
+        mesh_builder=lambda d: _trace_rounds_dp(**_RS_INT32, n_devices=d),
     ),
     "rounds_quant_rs_overflow": _Entry(
         lambda: _trace_rounds_dp(**_RS_OVERFLOW),
@@ -596,6 +680,8 @@ ENTRIES: Dict[str, _Entry] = {
         ],
         "quantized grower past the exactness bound: overflow gate "
         "engaged, f32 psum fallback",
+        mesh_builder=lambda d: _trace_rounds_dp(**_RS_OVERFLOW,
+                                                n_devices=d),
     ),
     "rounds_voting": _Entry(
         lambda: _trace_rounds_dp(**_RS_OK, voting_k=2),
@@ -614,6 +700,30 @@ ENTRIES: Dict[str, _Entry] = {
         "top-k election, only the elected bundle columns cross the mesh "
         "— int16 payload while the quantized sums provably fit; "
         "cost_audit pins the wire-bytes DROP vs rounds_quant_rs",
+        mesh_builder=lambda d: _trace_rounds_dp(**_RS_OK, voting_k=2,
+                                                n_devices=d),
+    ),
+    "feature_parallel": _Entry(
+        _trace_feature_parallel,
+        lambda budget: [
+            has_prim("all_gather",
+                     "SyncUpGlobalBestSplit: per-rank best records "
+                     "gathered, winner picked identically everywhere"),
+            has_prim("psum",
+                     "the winning shard broadcasts its per-row split "
+                     "decision (one bit-vector per split)"),
+            lacks_prim("reduce_scatter",
+                       "feature-parallel moves NO histograms — only "
+                       "split records and one row bit-vector"),
+            no_host_callbacks(),
+            no_f64(),
+            within_budget(budget),
+        ],
+        "feature-parallel flat grower (tree_learner=feature, "
+        "parallel_tree_learner.h:26): rows replicated, features "
+        "sharded, record-only wire — the second mesh axis ROADMAP 5's "
+        "2D rows x features sharding composes from",
+        mesh_builder=_trace_feature_parallel,
     ),
     "rounds_serial": _Entry(
         _trace_rounds_serial,
@@ -875,24 +985,47 @@ def audit_chunk_invariance() -> AuditResult:
 # ------------------------------------------------------------------ runner
 # entry traces are pure functions of checked-in shapes, and the strict
 # gate reads each one at least twice (jaxpr pass + cost pass, several
-# seconds per rounds trace) — memoize per (entry, interpret-mode)
+# seconds per rounds trace) — memoize per (entry, interpret-mode,
+# mesh size) so the scale auditor's D=8 rung shares the trace the
+# jaxpr/cost passes already paid for
 _CLOSED_CACHE: Dict[Any, Any] = {}
 
 
-def build_entry(name: str, pallas_interpret: bool = False):
+def mesh_entry_names() -> List[str]:
+    """Entries that trace through a device mesh (the scale auditor's
+    universe: anything whose collectives/shardings can vary with D)."""
+    return [n for n, e in ENTRIES.items() if e.mesh_builder is not None]
+
+
+def build_entry(name: str, pallas_interpret: bool = False,
+                n_devices: Optional[int] = None):
     """Entry ClosedJaxpr, memoized. With pallas_interpret the trace
     runs under the pallas interpreter (histogram._interpret_pallas
     reads the env var at trace time) so XLA:CPU can later compile it —
     the cost auditor's path for pallas entries. The env var is forced
     BOTH ways: an ambient LGBM_TPU_PALLAS_INTERPRET=1 (the pallas
     debugging knob) must not leak an interpreted trace into the
-    non-interpreted budget comparison."""
+    non-interpreted budget comparison.
+
+    n_devices retraces a mesh-bearing entry on a sub-mesh of the
+    forced host platform (the scale auditor's D-ladder). None means
+    the entry's default mesh; for mesh entries that is
+    HOST_DEVICE_COUNT, and the cache key normalizes the two spellings
+    to one slot so passes share the full-mesh trace."""
     import os
 
-    key = (name, bool(pallas_interpret))
+    entry = ENTRIES[name]
+    if n_devices is not None and entry.mesh_builder is None:
+        raise ValueError(
+            f"entry {name!r} has no mesh; n_devices={n_devices} is "
+            "meaningless (only mesh_entry_names() entries retrace on "
+            "the D-ladder)")
+    n = n_devices
+    if entry.mesh_builder is not None and n is None:
+        n = HOST_DEVICE_COUNT
+    key = (name, bool(pallas_interpret), n)
     if key in _CLOSED_CACHE:
         return _CLOSED_CACHE[key]
-    entry = ENTRIES[name]
     env_key = "LGBM_TPU_PALLAS_INTERPRET"
     old = os.environ.get(env_key)
     if pallas_interpret:
@@ -900,7 +1033,10 @@ def build_entry(name: str, pallas_interpret: bool = False):
     else:
         os.environ.pop(env_key, None)
     try:
-        closed = entry.builder()
+        if n is not None and n != HOST_DEVICE_COUNT:
+            closed = entry.mesh_builder(n)
+        else:
+            closed = entry.builder()
     finally:
         if old is None:
             os.environ.pop(env_key, None)

@@ -79,6 +79,17 @@ def _run_bench_gate(pkg_root: Optional[str],
     return PassResult("bench_gate", result.ok, result.format())
 
 
+def _run_scale(pkg_root: Optional[str], show_suppressed: bool) -> PassResult:
+    from .scale_audit import run_scale_audits
+
+    results = run_scale_audits()  # full D-ladder: the strict gate
+    return PassResult(
+        "scale",
+        all(r.ok for r in results),
+        "\n".join(r.format() for r in results),
+    )
+
+
 class AnalysisPass(NamedTuple):
     name: str
     needs_jax: bool
@@ -110,6 +121,12 @@ PASSES: Dict[str, AnalysisPass] = {
         "bench_gate", False,
         "BENCH_r*/BENCH_SERVE_r* trajectory regression gate against "
         "bench_budget.json pins (bench_gate.py)", _run_bench_gate,
+    ),
+    "scale": AnalysisPass(
+        "scale", True,
+        "SPMD scaling-contract auditor: collective census, wire "
+        "scaling laws, and sharding-spec verification over the "
+        "D in {1,2,4,8} mesh ladder (scale_audit.py)", _run_scale,
     ),
 }
 

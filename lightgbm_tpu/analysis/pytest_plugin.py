@@ -10,6 +10,10 @@ conftest. The in-repo tests import these fixtures from conftest.py.
 - `jaxpr_audit`: run named invariant audits inline and assert green.
 - `cost_audit`: run named cost/memory/wire-bytes audits inline and
   assert green (compiles the entries on the CPU backend).
+- `scale_audit`: run named SPMD scaling-contract audits inline and
+  assert green. Defaults to the tiny tier-1 D in {1, 2} ladder (the
+  full {1, 2, 4, 8} ladder is `--strict` / tools/analysis.sh
+  territory); pass `ladder=` to widen.
 - `concurrency_lint`: lint source text (or the installed package) with
   the serving lock-discipline rules and assert no unsuppressed
   findings.
@@ -48,6 +52,23 @@ def cost_audit():
 
     def run(names=None):
         results = run_cost_audits(names=names)
+        bad = [r.format() for r in results if not r.ok]
+        assert not bad, "\n".join(bad)
+        return results
+
+    return run
+
+
+@pytest.fixture
+def scale_audit():
+    """fixture(names=None, ladder=None) -> list[AuditResult],
+    asserting all green. ladder=None runs the tier-1 D in {1, 2}
+    subset (budget pins still checked EXACT at those rungs)."""
+    from .scale_audit import TIER1_LADDER, run_scale_audits
+
+    def run(names=None, ladder=None):
+        results = run_scale_audits(
+            names=names, ladder=ladder or TIER1_LADDER)
         bad = [r.format() for r in results if not r.ok]
         assert not bad, "\n".join(bad)
         return results

@@ -60,6 +60,7 @@ from lightgbm_tpu.analysis.pytest_plugin import (  # noqa: E402,F401
     cost_audit,
     jaxpr_audit,
     retrace_guard,
+    scale_audit,
 )
 
 

@@ -144,6 +144,39 @@ def test_every_entry_has_both_budgets():
         assert required <= set(b), f"{name} budget missing {required - set(b)}"
 
 
+def test_scale_budget_consistent_with_mesh_entries():
+    """Meta-test for Pass 7's pins: scale_budget.json keys == the
+    mesh-bearing entries == the declared SCALE_ENTRIES specs, and
+    every entry pins every rung of the full ladder with every budget
+    key (a missing rung would let the 4/8 legs rot while tier-1 only
+    exercises {1, 2})."""
+    from lightgbm_tpu.analysis.jaxpr_audit import mesh_entry_names
+    from lightgbm_tpu.analysis.scale_audit import (
+        _BUDGET_KEYS,
+        LADDER,
+        SCALE_ENTRIES,
+    )
+
+    scale = json.loads((BUDGETS / "scale_budget.json").read_text())
+    mesh = set(mesh_entry_names())
+    assert set(scale) == mesh, (
+        f"scale_budget.json keys {sorted(scale)} != mesh entries "
+        f"{sorted(mesh)} — run --refresh-budgets / prune orphans"
+    )
+    assert set(SCALE_ENTRIES) == mesh, (
+        f"SCALE_ENTRIES {sorted(SCALE_ENTRIES)} != mesh entries "
+        f"{sorted(mesh)} — declare a ScaleSpec for every mesh entry"
+    )
+    for name, pins in scale.items():
+        assert set(pins) == {str(d) for d in LADDER}, (
+            f"{name} pins rungs {sorted(pins)} != ladder {LADDER}"
+        )
+        for d, pin in pins.items():
+            assert set(pin) == set(_BUDGET_KEYS), (
+                f"{name}[D={d}] keys {sorted(pin)}"
+            )
+
+
 def test_strict_gate_runs_every_registered_pass(monkeypatch, capsys):
     """Meta-test: `--strict` exercises ALL registered auditors — stub
     every pass runner, drive the real CLI main(), and assert each got
@@ -184,7 +217,7 @@ def test_run_passes_rejects_unknown_names():
     with pytest.raises(KeyError, match="nope"):
         run_passes(["nope"])
     assert set(PASSES) == {"lint", "concurrency", "jaxpr", "cost",
-                           "bench_gate"}
+                           "bench_gate", "scale"}
 
 
 # ------------------------------------------------------ real entries

@@ -1,0 +1,210 @@
+"""SPMD scaling-contract auditor (analysis/scale_audit.py, Pass 7):
+red paths driven through synthetic per-rung summaries — a collective
+count that grows with D fails the census, a widened per-device payload
+fails the declared wire law, and a per-row array silently falling back
+to replication fails the sharding-spec table — plus the real tier-1
+green path via the `scale_audit` fixture. The heavy real-trace
+coverage (full D-ladder, all entries) lives in `--strict` /
+tools/analysis.sh, not here: the tier-1 suite runs ~770-860 s of its
+870 s budget already."""
+
+import pytest
+
+from lightgbm_tpu.analysis.scale_audit import (
+    SCALE_ENTRIES,
+    ScaleSpec,
+    ScaleSummary,
+    ShardRule,
+    audit_scale,
+    run_scale_audits,
+)
+
+_ROW_LEAF_RULES = (
+    ShardRule("per_row_sharded", r"in/0/float32\[N\]", "P(data)"),
+    ShardRule("row_leaf_sharded", r"out/0/int32\[N\]", "P(data)"),
+    ShardRule("rest_replicated", r"(in|out)/.*", "replicated"),
+)
+
+
+def _summary(census, send=100, rs_shard=0, eqns=50, shardings=(
+        ("in/0/float32[N]", "P(data)"),
+        ("out/0/int32[N]", "P(data)"),
+        ("in/1/float32[]", "replicated"),
+)) -> ScaleSummary:
+    return ScaleSummary(census=dict(census), send_bytes=send,
+                        rs_shard_bytes=rs_shard, eqn_count=eqns,
+                        shardings=tuple(shardings))
+
+
+def _pins(summaries):
+    from lightgbm_tpu.analysis.scale_audit import _pins_from
+
+    return _pins_from(summaries)
+
+
+def _spec(**kw) -> ScaleSpec:
+    base = dict(law="const", rules=_ROW_LEAF_RULES)
+    base.update(kw)
+    return ScaleSpec(**base)
+
+
+# --------------------------------------------------------- green base
+def test_synthetic_const_entry_green():
+    summaries = {1: _summary({"psum": 2}), 2: _summary({"psum": 2}),
+                 4: _summary({"psum": 2})}
+    r = audit_scale("fixture", _spec(), summaries, _pins(summaries))
+    assert r.ok, r.format()
+
+
+# ---------------------------------------------------------- red paths
+def test_collective_count_growing_with_d_fails_census():
+    """ACCEPTANCE red path (a): one psum per DEVICE instead of one per
+    step — the census is no longer D-invariant and the gate names the
+    offending rungs."""
+    summaries = {1: _summary({"psum": 1}), 2: _summary({"psum": 2}),
+                 4: _summary({"psum": 4})}
+    r = audit_scale("growing", _spec(), summaries, _pins(summaries))
+    assert not r.ok
+    bad = {c.name: c for c in r.contracts if not c.ok}
+    assert "census_D_invariant" in bad, r.format()
+    assert "varies with D" in bad["census_D_invariant"].detail
+
+
+def test_undeclared_all_gather_fails():
+    """An all_gather appearing where the entry declares none — even
+    D-invariantly — fails (gathering un-shards an array everywhere)."""
+    summaries = {d: _summary({"psum": 2, "all_gather": 1})
+                 for d in (1, 2, 4)}
+    r = audit_scale("gathered", _spec(allows_all_gather=False),
+                    summaries, _pins(summaries))
+    assert not r.ok
+    assert any(c.name == "no_undeclared_all_gather" and not c.ok
+               for c in r.contracts), r.format()
+
+
+def test_widened_payload_fails_wire_law():
+    """ACCEPTANCE red path (b): per-device payload that grows with D
+    fails `const`; a reduce-scatter shard that stops shrinking fails
+    `1/D`; an elected wire that stops undercutting its baseline fails
+    `elected`."""
+    # const law, payload doubles with the mesh
+    grow = {1: _summary({"psum": 2}, send=100),
+            2: _summary({"psum": 2}, send=200),
+            4: _summary({"psum": 2}, send=400)}
+    r = audit_scale("widened", _spec(), grow, _pins(grow))
+    assert not r.ok
+    assert any(c.name == "wire_law_const" and not c.ok
+               for c in r.contracts), r.format()
+
+    # 1/D law, shard bytes flat (someone dropped the scatter)
+    flat = {d: _summary({"reduce_scatter": 1}, send=100, rs_shard=64)
+            for d in (2, 4, 8)}
+    r2 = audit_scale("unscattered", _spec(law="1/D", floor=2),
+                     flat, _pins(flat))
+    assert not r2.ok
+    assert any(c.name == "wire_law_1/D" and not c.ok
+               for c in r2.contracts), r2.format()
+    # ...and the true 1/D shape passes
+    good = {d: _summary({"reduce_scatter": 1}, send=100,
+                        rs_shard=512 // d) for d in (2, 4, 8)}
+    r3 = audit_scale("scattered", _spec(law="1/D", floor=2),
+                     good, _pins(good))
+    assert r3.ok, r3.format()
+
+    # elected law: flat but NOT under the baseline wire
+    elected = {d: _summary({"psum": 3}, send=500) for d in (2, 4)}
+    baseline = {d: _summary({"reduce_scatter": 1}, send=400)
+                for d in (2, 4)}
+    r4 = audit_scale(
+        "bloated_election",
+        _spec(law="elected", floor=2, baseline="rounds_quant_rs"),
+        elected, _pins(elected), baseline=baseline,
+    )
+    assert not r4.ok
+    assert any(c.name == "elected_undercuts_baseline" and not c.ok
+               for c in r4.contracts), r4.format()
+
+
+def test_eqn_count_scaling_with_d_fails():
+    summaries = {1: _summary({"psum": 1}, eqns=50),
+                 2: _summary({"psum": 1}, eqns=90),
+                 4: _summary({"psum": 1}, eqns=170)}
+    r = audit_scale("unrolled", _spec(eqn_tol=8), summaries,
+                    _pins(summaries))
+    assert not r.ok
+    assert any(c.name == "eqns_D_invariant" and not c.ok
+               for c in r.contracts), r.format()
+
+
+def test_replicated_per_row_output_fails_sharding_rules():
+    """ACCEPTANCE red path (c): the per-row leaf output silently falls
+    back to full replication (the 8x-memory failure the
+    match_partition_rules table exists to catch)."""
+    summaries = {d: _summary({"psum": 1}, shardings=(
+        ("in/0/float32[N]", "P(data)"),
+        ("out/0/int32[N]", "replicated"),   # <- the silent fallback
+        ("in/1/float32[]", "replicated"),
+    )) for d in (1, 2)}
+    r = audit_scale("replicated", _spec(), summaries, _pins(summaries))
+    assert not r.ok
+    bad = {c.name: c for c in r.contracts if not c.ok}
+    assert "sharding_rules" in bad, r.format()
+    assert "row_leaf_sharded" in bad["sharding_rules"].detail
+
+    # an array no rule covers fails too (the table must stay total)
+    uncovered = {1: _summary({"psum": 1}, shardings=(
+        ("smap1/in/0/float32[N]", "P(data)"),
+    ))}
+    r2 = audit_scale("uncovered", _spec(), uncovered, _pins(uncovered))
+    assert any(c.name == "sharding_rules" and not c.ok
+               and "matches no sharding rule" in c.detail
+               for c in r2.contracts), r2.format()
+
+    # a rule matching nothing is a stale table, not a free pass
+    assert any(
+        c.name == "sharding_rules" and "matched nothing" in c.detail
+        for c in r2.contracts if not c.ok
+    ), r2.format()
+
+
+def test_missing_or_stale_budget_fails():
+    summaries = {1: _summary({"psum": 2}), 2: _summary({"psum": 2})}
+    r = audit_scale("nobudget", _spec(), summaries, None)
+    assert any(c.name == "scale_budget" and not c.ok
+               for c in r.contracts), r.format()
+    stale = _pins(summaries)
+    stale["2"]["send_bytes"] = 1  # drifted pin
+    r2 = audit_scale("stale", _spec(), summaries, stale)
+    assert not r2.ok
+    assert any(c.name == "scale_budget" and "send_bytes" in c.detail
+               for c in r2.contracts if not c.ok), r2.format()
+
+
+# ----------------------------------------------------- real entries
+def test_unknown_entry_name_raises():
+    with pytest.raises(KeyError, match="typo_entry"):
+        run_scale_audits(names=["typo_entry"])
+
+
+def test_specs_declare_every_law_archetype():
+    """The declared table covers all four laws (the docs' contract),
+    and the voting baseline is a real entry."""
+    laws = {s.law for s in SCALE_ENTRIES.values()}
+    assert laws == {"const", "1/D", "elected", "bounded"}
+    for name, s in SCALE_ENTRIES.items():
+        if s.baseline is not None:
+            assert s.baseline in SCALE_ENTRIES, (name, s.baseline)
+        assert s.rules, f"{name} declares no sharding rules"
+
+
+def test_tier1_ladder_green_via_fixture(scale_audit):
+    """The real tier-1 hook: D in {1, 2} on the elected entry and its
+    1/D baseline — exact budget pins at both rungs, sharding table
+    verified against the real shard_map in/out names. (The fixture
+    shares build_entry's memo with test_static_analysis's strict-
+    equivalent run, so the traces are paid once per process.)"""
+    results = scale_audit(names=["rounds_voting"])
+    assert [r.name for r in results] == ["rounds_voting"]
+    by_contract = {c.name: c for c in results[0].contracts}
+    assert "elected_undercuts_baseline" in by_contract
+    assert "scale_budget" in by_contract

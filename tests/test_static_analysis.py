@@ -138,21 +138,17 @@ def test_format_findings_counts():
 
 
 # ----------------------------------------------------- jaxpr contracts
-def _mesh():
-    import jax
-    from jax.sharding import Mesh
-
-    return Mesh(np.asarray(jax.devices()), ("data",))
-
-
 def _wire_fixture_jaxpr(widen: bool):
     """An 8-shard psum_scatter wire, int32 or deliberately f32-widened
-    (shared with tests/test_cost_audit.py's wire-bytes tests)."""
+    (shared with tests/test_cost_audit.py's wire-bytes tests). Uses
+    the audit suite's own `_mesh()` (the one XLA_FLAGS bootstrap
+    owner) rather than a private mesh builder."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
+    from lightgbm_tpu.analysis.jaxpr_audit import _mesh
     from lightgbm_tpu.parallel.data_parallel import shard_map_compat
 
     mesh = _mesh()
@@ -415,3 +411,20 @@ def test_strict_equivalent_in_process():
 
     gate = run_gate()
     assert gate.ok, gate.format()
+    # Pass 7 (scaling contracts) tier-1 hook: the tiny D in {1, 2}
+    # ladder on the three law archetypes (1/D, elected + its baseline,
+    # bounded) — budget pins still checked EXACT at those rungs. The
+    # int32/overflow entries and the 4/8 rungs ride --strict /
+    # tools/analysis.sh; re-tracing all five entries at every rung
+    # here would blow the tier-1 time budget.
+    from lightgbm_tpu.analysis.scale_audit import (
+        TIER1_LADDER,
+        run_scale_audits,
+    )
+
+    sresults = run_scale_audits(
+        names=["rounds_quant_rs", "rounds_voting", "feature_parallel"],
+        ladder=TIER1_LADDER,
+    )
+    sbad = [r.format() for r in sresults if not r.ok]
+    assert not sbad, "\n".join(sbad)
