@@ -42,6 +42,9 @@ BENCH_SKIP_VOTING, BENCH_VOTING_TREES, BENCH_VOTING_EXACT_TREES,
 BENCH_VOTING_LEAVES, BENCH_VOTING_TOPK.
 Chunk-scan segment (tpu_chunk_scan=auto vs off, same run):
 BENCH_SKIP_CHUNK_SCAN, BENCH_CHUNK_TREES.
+Ingest segment (out-of-core data plane, docs/DATA_PLANE.md):
+BENCH_SKIP_INGEST, BENCH_INGEST_ROWS, BENCH_INGEST_TREES,
+BENCH_INGEST_BUDGET_MB, BENCH_INGEST_CHUNK_ROWS.
 """
 
 import importlib.util
@@ -199,6 +202,10 @@ def _final_json():
               "chunk_scan_speedup", "chunk_scan_dispatches",
               "chunk_scan_off_dispatches", "chunk_scan_host_ms_per_tree",
               "chunk_scan_off_host_ms_per_tree",
+              "ingest_rows", "ingest_features", "ingest_chunks",
+              "ingest_ram_budget_mb", "ingest_spool_rows_per_sec",
+              "ingest_bin_rows_per_sec", "ingest_fit_trees_per_sec",
+              "ingest_peak_rss_mb", "ingest_rss_spread_mb",
               "run_id", "run_manifest"):
         if k in _STATE:
             out[k] = _STATE[k]
@@ -552,6 +559,69 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] chunk_scan segment failed: {e}\n")
+
+    # ingest segment: the out-of-core data plane (docs/DATA_PLANE.md) —
+    # spool the bench matrix to a disk chunk store, stream the two-pass
+    # binning, then fit with the double-buffered assembly under a RAM
+    # budget far below the raw footprint. Reports spool and bin rows/sec
+    # plus the per-chunk RSS spread the flat-memory contract promises.
+    if not os.environ.get("BENCH_SKIP_INGEST"):
+        irows = int(os.environ.get("BENCH_INGEST_ROWS", rows))
+        itrees = int(os.environ.get("BENCH_INGEST_TREES", min(trees, 10)))
+        ibudget = int(os.environ.get("BENCH_INGEST_BUDGET_MB", 256))
+        save_partial(stage="ingest")
+        try:
+            from lightgbm_tpu.data import last_stats, reset_stats
+
+            if irows <= rows:
+                Xi, yi = X[:irows], y[:irows]
+            else:
+                # ingest is an I/O-plane measurement — it can (and on the
+                # CPU fallback should) run far bigger than the training
+                # matrix the trees/sec segments were downshifted to
+                rsi = np.random.RandomState(29)
+                Xi = rsi.randn(irows, feats).astype(np.float32)
+                yi = (Xi[:, 0] + rsi.randn(irows) > 0).astype(np.float32)
+            reset_stats()
+            iparams = dict(params, data_source="chunked",
+                           ram_budget_mb=ibudget)
+            if os.environ.get("BENCH_INGEST_CHUNK_ROWS"):
+                iparams["data_chunk_rows"] = int(
+                    os.environ["BENCH_INGEST_CHUNK_ROWS"])
+            ids = lgb.Dataset(Xi, label=yi, params=iparams,
+                              free_raw_data=False)
+            t0 = time.time()
+            if itrees > 0:
+                lgb.train(dict(iparams), ids, num_boost_round=itrees)
+            else:
+                # trees=0: measure the data plane alone — spool, two-pass
+                # bin, and the prefetched device assembly — without a
+                # training run (on the CPU fallback a 10M-row fit blows
+                # the bench budget; the trees/sec segments above already
+                # cover training throughput)
+                ids.construct()
+                ids._binned.device_arrays()
+            fit_s = time.time() - t0
+            st = last_stats() or {}
+            asm = st.get("assemble", {})
+            save_partial(
+                ingest_rows=irows,
+                ingest_features=feats,
+                ingest_ram_budget_mb=ibudget,
+                ingest_chunks=asm.get("chunks"),
+                ingest_spool_rows_per_sec=st.get("spool", {}).get(
+                    "rows_per_sec"),
+                ingest_bin_rows_per_sec=st.get("pass2", {}).get(
+                    "rows_per_sec"),
+                ingest_peak_rss_mb=asm.get("peak_rss_mb"),
+                ingest_rss_spread_mb=asm.get("rss_spread_mb"),
+            )
+            if itrees > 0:
+                save_partial(
+                    ingest_fit_trees_per_sec=round(itrees / fit_s, 4))
+            del ids, Xi, yi
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] ingest segment failed: {e}\n")
 
     # third segment: voting-parallel (tree_learner=voting riding the
     # rounds grower) against the sequential exact oracle

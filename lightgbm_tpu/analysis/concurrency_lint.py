@@ -39,6 +39,17 @@ Rules:
   every other thread needing that lock stalls behind the wait.
   ``cond.wait()`` on the very condition being held is the coalescing
   idiom and exempt (wait releases the lock).
+- ``unbounded-producer-queue`` — a module spawns a thread whose target
+  ``.put``s into a queue inside a loop (a streaming producer, e.g. the
+  data-plane prefetch reader), yet constructs a queue without a
+  positive ``maxsize``: the producer can outrun the consumer without
+  backpressure and host memory grows with the input. Put-once targets
+  (the gateway's hedged-attempt threads) don't trip this.
+- ``jax-in-reader-thread`` — a queue-producer thread target calls into
+  ``jax.*``/``jnp.*`` beyond the ``jax.device_put`` transfer: tracing
+  or compiling off the main thread races the global trace state, and
+  dispatch from two threads serializes on the backend anyway
+  (docs/DATA_PLANE.md prefetch contract).
 """
 
 from __future__ import annotations
@@ -80,6 +91,19 @@ BLOCKING_UNDER_LOCK = _register(
     "lock stalls behind the wait (move the slow work outside the "
     "critical section)",
 )
+UNBOUNDED_PRODUCER_QUEUE = _register(
+    "unbounded-producer-queue",
+    "unbounded queue in a module whose thread target puts inside a "
+    "loop — the producer can run arbitrarily far ahead of the "
+    "consumer, unbounding host memory (give the queue a maxsize)",
+)
+JAX_IN_READER_THREAD = _register(
+    "jax-in-reader-thread",
+    "JAX call other than jax.device_put on a queue-producer thread — "
+    "tracing/compiling off the main thread races the trace state and "
+    "serializes on the backend; producer threads stay host-only "
+    "except for the transfer itself",
+)
 
 # primitive constructors; value = reentrant? (safe to re-acquire)
 _LOCK_KINDS: Dict[str, bool] = {
@@ -106,6 +130,10 @@ _BLOCKING_LEAVES = {
 }
 # subprocess.<leaf> that wait for the child
 _SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+# queue constructors (queue module / multiprocessing); SimpleQueue has
+# no maxsize parameter at all
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+_PUT_LEAVES = {"put", "put_nowait"}
 
 
 class _FnSummary(NamedTuple):
@@ -491,6 +519,138 @@ class _ConcurrencyLinter:
                         f"in {cls} but written here ({fn}) without it",
                     )
 
+    # -------------------------------------------- prefetch-thread rules
+    def _queue_ctor(self, call: ast.AST) -> Optional[str]:
+        """Queue-class leaf when `call` constructs a queue (queue.X(),
+        multiprocessing.X(), or a bare imported X())."""
+        if not isinstance(call, ast.Call):
+            return None
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        leaf = parts[-1]
+        if leaf not in _QUEUE_CTORS:
+            return None
+        if len(parts) == 1 or parts[0] in ("queue", "multiprocessing"):
+            return leaf
+        return None
+
+    @staticmethod
+    def _queue_bounded(call: ast.Call, leaf: str) -> bool:
+        """True when the constructor pins a positive maxsize.
+        Non-constant expressions (max(1, depth), a parameter) count as
+        bounded — the author made capacity a decision; only a missing
+        or literal-0 maxsize is structurally unbounded."""
+        if leaf == "SimpleQueue":
+            return False
+        arg: Optional[ast.AST] = call.args[0] if call.args else None
+        for k in call.keywords:
+            if k.arg == "maxsize":
+                arg = k.value
+        if arg is None:
+            return False
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, int):
+            return arg.value > 0
+        return True
+
+    @staticmethod
+    def _puts_in_scope(fn_node: ast.AST) -> Tuple[bool, bool]:
+        """(has_put, put_in_loop) for a function body, not descending
+        into nested defs."""
+        has_put = False
+        in_loop_put = False
+
+        def visit(node: ast.AST, in_loop: bool) -> None:
+            nonlocal has_put, in_loop_put
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d is not None and d.split(".")[-1] in _PUT_LEAVES:
+                    has_put = True
+                    if in_loop:
+                        in_loop_put = True
+            nxt = in_loop or isinstance(
+                node, (ast.For, ast.AsyncFor, ast.While)
+            )
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                visit(child, nxt)
+
+        visit(fn_node, False)
+        return has_put, in_loop_put
+
+    def _thread_targets(self) -> Dict[str, ast.AST]:
+        """fn key -> Thread(...) call node, for every
+        threading.Thread(target=<name>|self.<meth>) whose target
+        resolves to a module function or sibling method."""
+        targets: Dict[str, ast.AST] = {}
+        for s in self.fns.values():
+            for n in self._walk_scope(s.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                d = _dotted(n.func)
+                if d not in ("threading.Thread", "Thread"):
+                    continue
+                for k in n.keywords:
+                    if k.arg != "target":
+                        continue
+                    td = _dotted(k.value)
+                    if td is None:
+                        continue
+                    if td.startswith("self.") and s.cls is not None:
+                        key = f"{s.cls}.{td[len('self.'):]}"
+                    else:
+                        key = td
+                    if key in self.fns:
+                        targets.setdefault(key, n)
+        return targets
+
+    def _check_prefetch_threads(self) -> None:
+        """The two data-plane rules (docs/DATA_PLANE.md prefetch
+        contract): a module whose thread target `.put`s inside a loop
+        must not construct unbounded queues, and any queue-producer
+        thread target must stay JAX-free except for the device_put
+        transfer itself."""
+        targets = self._thread_targets()
+        looping_producer = False
+        for key in targets:
+            s = self.fns[key]
+            has_put, in_loop = self._puts_in_scope(s.node)
+            if in_loop:
+                looping_producer = True
+            if has_put:
+                for n in self._walk_scope(s.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    d = _dotted(n.func)
+                    if d is None:
+                        continue
+                    if (
+                        (d.startswith("jax.") or d.startswith("jnp."))
+                        and d != "jax.device_put"
+                    ):
+                        self._emit(
+                            JAX_IN_READER_THREAD, n,
+                            f"{d}() on producer thread target "
+                            f"{s.qualname!r} — only jax.device_put is "
+                            "safe off the main thread",
+                        )
+        if not looping_producer:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = self._queue_ctor(node)
+            if leaf is not None and not self._queue_bounded(node, leaf):
+                self._emit(
+                    UNBOUNDED_PRODUCER_QUEUE, node,
+                    f"{leaf}() constructed without a positive maxsize "
+                    "in a module with a looping producer thread — "
+                    "bound it so the producer backpressures",
+                )
+
     def _check_lock_order(self) -> None:
         seen: Set[Tuple[str, str]] = set()
         for (a, b), (node, fn) in sorted(
@@ -517,6 +677,7 @@ class _ConcurrencyLinter:
             self._scan_fn(s)
         self._check_unlocked_writes()
         self._check_lock_order()
+        self._check_prefetch_threads()
         # dedupe (nested walk can visit a call twice through With items)
         uniq: Dict[Tuple[str, int, int, str], Finding] = {}
         for f in self.findings:

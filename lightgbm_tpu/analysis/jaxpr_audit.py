@@ -622,6 +622,27 @@ def _trace_chunk_scan(length: int = _CHUNK_SCAN_C):
     return trace_fused_chunk(length)
 
 
+def _trace_streamed_construct():
+    """The per-chunk device step of the out-of-core construct
+    (data/prefetch.py chunk_update_step): dynamic_update_slice of one
+    (G, chunk_rows) int32 chunk into the (G, Np) resident bin matrix
+    at a traced row offset. Everything else on that path (spool reads,
+    crc checks, binning, padding) is host work on the reader thread —
+    this is the entire device-side surface, so it must stay
+    callback-free and f64-free."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..data.prefetch import chunk_update_step
+
+    G, NP, CR = 8, 8192, 2048
+    mk = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+    return jax.make_jaxpr(chunk_update_step)(
+        mk((G, NP), jnp.int32), mk((G, CR), jnp.int32),
+        mk((), jnp.int32),
+    )
+
+
 ENTRIES: Dict[str, _Entry] = {
     "fused_chunk_scan": _Entry(
         _trace_chunk_scan,
@@ -816,6 +837,22 @@ ENTRIES: Dict[str, _Entry] = {
         "online promotion-gate holdout evaluator (online/gate.py): "
         "device metrics over the candidate's raw margins — the gate "
         "verdict must stay callback-free and f32",
+    ),
+    "streamed_construct": _Entry(
+        _trace_streamed_construct,
+        lambda budget: [
+            has_prim("dynamic_update_slice",
+                     "each chunk lands at its row offset in the "
+                     "resident bin matrix"),
+            no_host_callbacks(),
+            no_f64(),
+            within_budget(budget),
+        ],
+        "out-of-core per-chunk device step (data/prefetch.py "
+        "chunk_update_step): one int32 chunk written into the "
+        "(G, Np) resident matrix — the only device work on the "
+        "streamed construct path; the disk reads/binning stay on the "
+        "prefetch reader thread (docs/DATA_PLANE.md)",
     ),
 }
 

@@ -202,12 +202,95 @@ class Dataset:
                 out.append(int(c))
         return out
 
+    def _construct_chunked(self, cfg, _gt):
+        """data_source=chunked construct. Returns the streamed binned
+        dataset, or None when this input must use a legacy path."""
+        from .data.store import ChunkStoreError, SpooledData
+
+        if self.reference is not None:
+            log.warning(
+                "data_source=chunked: valid sets with reference= must "
+                "bin with the training set's mappers; using the in-RAM "
+                "path"
+            )
+            return None
+        if cfg.linear_tree:
+            log.warning(
+                "data_source=chunked does not retain raw feature "
+                "values required by linear_tree; using the in-RAM path"
+            )
+            return None
+        data = self.data
+        if isinstance(data, (str, Path)):
+            from .parsers import is_binary_file
+
+            if is_binary_file(str(data)):
+                return None  # .bin caches load pre-binned as-is
+        elif hasattr(data, "tocsc") and hasattr(data, "tocsr"):
+            log.warning(
+                "data_source=chunked does not ingest scipy sparse "
+                "matrices; using the sparse in-RAM path"
+            )
+            return None
+        names = (
+            [str(n) for n in self.feature_name]
+            if isinstance(self.feature_name, list)
+            else None
+        )
+        cat = self._resolve_categorical(names or [])
+        if _is_sequence_input(data):
+            if not isinstance(data, list):
+                data = [data]
+        elif not isinstance(data, (str, Path, SpooledData, np.ndarray)):
+            arr, pandas_names = _to_2d_numpy(data)
+            data = arr
+            if names is None and pandas_names is not None:
+                names = pandas_names
+        from .data.streaming import construct_chunked
+
+        try:
+            with _gt.scope("dataset construct (chunked stream)"):
+                return construct_chunked(
+                    data, cfg,
+                    label=self.label,
+                    weight=self.weight,
+                    group=self.group,
+                    init_score=self.init_score,
+                    position=self.position,
+                    categorical_feature=cat,
+                    feature_names=names,
+                )
+        except ChunkStoreError as e:
+            log.warning(
+                f"data_source=chunked ingestion failed ({e}); falling "
+                "back to the in-RAM path"
+            )
+            return None
+
     def construct(self) -> "Dataset":
         if self._binned is not None:
             return self
         if self.data is None:
             log.fatal("Cannot construct Dataset: raw data was freed")
         from .timer import global_timer as _gt
+
+        from .data.store import SpooledData
+
+        cfg_src = Config(self.params)
+        if (cfg_src.data_source == "chunked"
+                or isinstance(self.data, SpooledData)):
+            # out-of-core construct (docs/DATA_PLANE.md): spool to a
+            # chunk store, stream two-pass binning, assemble the device
+            # matrix chunk-wise. Ineligible inputs warn and fall
+            # through to the legacy paths below.
+            binned = self._construct_chunked(cfg_src, _gt)
+            if binned is not None:
+                self._binned = binned
+                if self.feature_name == "auto" and binned.feature_names:
+                    self.feature_name = list(binned.feature_names)
+                if self.free_raw_data:
+                    self.data = None
+                return self
 
         if _is_sequence_input(self.data):
             # streaming two-pass path (reference Sequence / push APIs)
@@ -268,15 +351,19 @@ class Dataset:
                 and self.categorical_feature in ("auto", None, "")
             )
             want_stream = cfg_file.two_round
-            if (not want_stream and stream_ok
-                    and os.path.getsize(path) > (1 << 30)):
-                log.warning(
-                    f"text file {path} is over 1 GB; pass two_round="
-                    "true to stream it with bounded host memory. Note "
-                    "the streamed path bins from reservoir-sampled "
-                    "rows, so results may differ slightly from the "
-                    "whole-file loader (parity deviation documented in "
-                    "docs/DESIGN_DECISIONS.md)."
+            if not want_stream and stream_ok:
+                # single memory-budget warning path (data plane knob):
+                # ram_budget_mb=0 keeps the legacy 1 GB threshold
+                from .data import warn_over_budget
+
+                warn_over_budget(
+                    f"text file {path}", os.path.getsize(path),
+                    cfg_file.ram_budget_mb,
+                    "pass two_round=true or data_source=chunked to "
+                    "stream it with bounded host memory (streamed "
+                    "binning samples rows, so results may differ "
+                    "slightly from the whole-file loader; parity "
+                    "deviation documented in docs/DESIGN_DECISIONS.md)",
                 )
             if want_stream and not stream_ok:
                 log.warning(

@@ -141,6 +141,21 @@ _PARAMS: Dict[str, _P] = {
     "ignore_column": ("", str, ("ignore_feature", "blacklist"), None),
     "categorical_feature": ("", str, ("cat_feature", "categorical_column", "cat_column", "categorical_features"), None),
     "forcedbins_filename": ("", str, (), None),
+    # ---- out-of-core data plane (lightgbm_tpu/data, docs/DATA_PLANE.md) ----
+    # memory = legacy in-RAM construction; chunked = spool the input to
+    # a disk-backed chunk store and stream two-pass binning + the
+    # device push, bounding host memory by ram_budget_mb instead of
+    # dataset size
+    "data_source": ("memory", str, (),
+                    lambda v: v in ("memory", "chunked")),
+    # host RAM budget (MB) for the data plane: chunk sizing, prefetch
+    # depth, and the single over-budget warning path (0 = 1024, the
+    # legacy two_round >1GB text-size threshold)
+    "ram_budget_mb": (0, int, (), _nonneg),
+    # fixed rows per spool chunk; 0 = derived from ram_budget_mb
+    "data_chunk_rows": (0, int, (), _nonneg),
+    # spool directory for chunk stores; empty = self-cleaning temp dir
+    "data_spool_dir": ("", str, (), None),
     "save_binary": (False, bool, ("is_save_binary", "is_save_binary_file"), None),
     "precise_float_parser": (False, bool, (), None),
     "parser_config_file": ("", str, (), None),
@@ -442,6 +457,7 @@ DATASET_PARAMS = frozenset({
     "categorical_feature", "linear_tree", "tpu_row_block",
     "monotone_constraints", "header", "label_column", "weight_column",
     "group_column", "ignore_column", "two_round", "pre_partition",
+    "data_source", "ram_budget_mb", "data_chunk_rows", "data_spool_dir",
 })
 
 

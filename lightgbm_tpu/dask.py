@@ -38,6 +38,61 @@ def _materialize(obj: Any):
     return obj
 
 
+def _spool_partitions(X: Any, params: dict):
+    """Dask collection -> SpooledData, one partition at a time.
+
+    The out-of-core alternative to `_materialize`'s whole-collection
+    gather (docs/DATA_PLANE.md): each delayed partition is computed and
+    appended to a disk-backed chunk store, so host memory holds one
+    partition + one buffered chunk instead of the full collection.
+    Returns None when X is not partition-aware (plain arrays, or the
+    store is off) — callers then keep the legacy single-process
+    materialize semantics."""
+    to_delayed = getattr(X, "to_delayed", None)
+    if not callable(to_delayed):
+        return None
+    import numpy as np
+
+    from .config import Config
+    from .data.store import ChunkStore, SpooledData
+    from .data.streaming import _spool_root, resolve_chunk_rows
+
+    cfg = Config({
+        k: params[k] for k in
+        ("data_source", "ram_budget_mb", "data_chunk_rows",
+         "data_spool_dir")
+        if params.get(k) is not None
+    })
+    # dask.array -> (row_chunks, col_chunks) object grid;
+    # dask.dataframe -> flat list of partitions
+    grid = np.asarray(to_delayed(), dtype=object)
+    if grid.ndim == 0:
+        grid = grid.reshape(1, 1)
+    elif grid.ndim == 1:
+        grid = grid.reshape(-1, 1)
+    _owned, root = _spool_root(cfg)
+    store = None
+    for row in grid:
+        blocks = [np.asarray(_materialize(b)) for b in row]
+        block = (
+            blocks[0] if len(blocks) == 1
+            else np.concatenate(
+                [b.reshape(b.shape[0], -1) for b in blocks], axis=1
+            )
+        )
+        if block.ndim == 1:
+            block = block.reshape(-1, 1)
+        if store is None:
+            store = ChunkStore.create(
+                root / "raw", n_features=block.shape[1],
+                chunk_rows=resolve_chunk_rows(block.shape[1], cfg),
+            )
+        store.append_rows(block)
+    if store is None:
+        return None
+    return SpooledData(store.finalize())
+
+
 class _DaskMixin:
     """client= plumbing shared by the three estimators.
 
@@ -125,6 +180,10 @@ class _DaskMixin:
         return kwargs
 
     def fit(self, X, y, **kwargs):  # noqa: D102 - see class docstring
+        if self._other_params.get("data_source") == "chunked":
+            spooled = _spool_partitions(X, self.get_params())
+            if spooled is not None:
+                X = spooled
         return super().fit(
             _materialize(X), _materialize(y),
             **self._materialize_fit_args(dict(kwargs)),

@@ -1,0 +1,92 @@
+"""Out-of-core data plane (docs/DATA_PLANE.md).
+
+Dataset size bounded by disk, not host RAM (ROADMAP item 3b; the
+reference streams Criteo-class text via two_round loading and the
+Sequence ABC — this package generalizes that to ANY input kind):
+
+- ``store``      — disk-backed chunked columnar store: fixed-row-count
+                   chunks of feature columns in a spool directory with
+                   an atomically-committed manifest; writable from
+                   numpy arrays, the text parsers, any iterator of row
+                   blocks, or Dask partitions (dask.py).
+- ``streaming``  — two-pass binning over a store: pass 1 samples rows
+                   to fit bin mappers + the EFB layout, pass 2 re-reads
+                   chunks and spools the packed bin representation —
+                   never two raw chunks resident at once.
+- ``prefetch``   — double-buffered host->HBM chunk transfers behind a
+                   bounded queue, feeding the streamed device-matrix
+                   assembly in dataset/streaming.
+
+One memory-budget knob governs the whole plane: ``ram_budget_mb``
+(0 = the legacy 1 GB threshold the two_round size warning always
+used). :func:`ram_budget_bytes` resolves it and
+:func:`warn_over_budget` is the single warning path for any component
+about to exceed it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .. import log
+
+# resolved default when ram_budget_mb is 0/unset — the 1 GB threshold
+# the ad-hoc two_round text-size warning used before this knob existed
+DEFAULT_RAM_BUDGET_MB = 1024
+
+
+def ram_budget_bytes(ram_budget_mb: int) -> int:
+    """Resolve the configured budget (MB, 0 = default) to bytes."""
+    mb = int(ram_budget_mb) if ram_budget_mb else DEFAULT_RAM_BUDGET_MB
+    return mb << 20
+
+
+def warn_over_budget(what: str, nbytes: int, ram_budget_mb: int,
+                     hint: str) -> bool:
+    """THE memory-budget warning path: one format, one knob. Returns
+    whether the warning fired (callers branch on it for tests)."""
+    budget = ram_budget_bytes(ram_budget_mb)
+    if nbytes <= budget:
+        return False
+    log.warning(
+        f"{what} is {nbytes / (1 << 20):.0f} MB, over the "
+        f"{budget >> 20} MB host RAM budget "
+        f"(ram_budget_mb={int(ram_budget_mb) or 0}, 0 = "
+        f"{DEFAULT_RAM_BUDGET_MB} MB default); {hint}"
+    )
+    return True
+
+
+# ---------------------------------------------------------------------------
+# data-plane run stats: the most recent ingestion's footprint, folded
+# into the run manifest as manifest["data_plane"] (obs/manifest.py) —
+# same last-run registry pattern as the flight recorder's
+# last_summary(). Guarded by a lock: the prefetcher's reader thread
+# reports per-chunk stats concurrently with the consumer.
+# ---------------------------------------------------------------------------
+_stats_lock = threading.Lock()
+_last_stats: Optional[Dict[str, Any]] = None
+
+
+def record_stats(section: str, payload: Dict[str, Any]) -> None:
+    """Merge one section (spool/pass1/pass2/assemble/...) into the
+    current data-plane record."""
+    global _last_stats
+    with _stats_lock:
+        if _last_stats is None:
+            _last_stats = {}
+        _last_stats[section] = payload
+
+
+def last_stats() -> Optional[Dict[str, Any]]:
+    """The most recent data-plane record, or None when the chunked
+    plane has not run in this process."""
+    with _stats_lock:
+        return None if _last_stats is None else dict(_last_stats)
+
+
+def reset_stats() -> None:
+    global _last_stats
+    with _stats_lock:
+        _last_stats = None

@@ -1,0 +1,158 @@
+"""Double-buffered host->HBM chunk prefetch (docs/DATA_PLANE.md
+"Prefetch contract").
+
+While the device consumes chunk *k* (one dynamic_update_slice into the
+resident bin matrix), a background reader thread prepares chunk *k+1*:
+read from the spool, verify, convert to the device dtype, pad, and
+start the host->device transfer. The thread hands device buffers
+through a BOUNDED queue (maxsize = prefetch depth), so host memory is
+capped at (depth + 1) chunks no matter how far the reader could run
+ahead.
+
+Thread discipline (pinned by analysis/concurrency_lint.py):
+
+- the producer queue is constructed with an explicit maxsize
+  (``unbounded-producer-queue``);
+- the reader thread performs NO JAX work other than the
+  ``jax.device_put`` transfer itself (``jax-in-reader-thread``) —
+  tracing/compilation from a non-main thread races the main thread's
+  trace state, and dispatching compiled computations from two threads
+  serializes on the backend anyway.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+DEFAULT_PREFETCH_DEPTH = 2
+
+# sentinel chunk index for an exception crossing the thread boundary
+_ERR = -1
+
+
+def chunk_update_step(buf, chunk, lo):
+    """Pure per-chunk device step of the streamed construct: write one
+    (G, chunk_rows) bin block into the resident (G, Np) matrix at
+    column offset ``lo``. Traced once per chunk width (constant body +
+    tail), audited by analysis/jaxpr_audit.py entry
+    ``streamed_construct`` (no host callbacks, no f64)."""
+    import jax.lax as lax
+
+    return lax.dynamic_update_slice(buf, chunk, (0, lo))
+
+
+def read_rss_mb() -> float:
+    """Current resident set size of this process in MB (Linux
+    /proc/self/statm; 0.0 where unavailable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os
+
+        return pages * os.sysconf("SC_PAGE_SIZE") / (1 << 20)
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
+def prefetch_depth(chunk_bytes: int, budget_bytes: int) -> int:
+    """Queue depth that keeps (depth + 1) in-flight chunks inside the
+    RAM budget, clamped to [1, DEFAULT_PREFETCH_DEPTH * 2]."""
+    if chunk_bytes <= 0:
+        return DEFAULT_PREFETCH_DEPTH
+    fit = budget_bytes // max(1, chunk_bytes) - 1
+    return int(max(1, min(DEFAULT_PREFETCH_DEPTH * 2, fit,
+                          DEFAULT_PREFETCH_DEPTH)))
+
+
+class ChunkPrefetcher:
+    """Background reader streaming device-resident chunks in order.
+
+    ``load_fn(idx)`` runs ON THE READER THREAD and must be host-only:
+    read + verify the chunk, bin/convert/pad it, and return
+    (np_chunk, payload) where np_chunk is the ready-to-transfer array
+    and payload is arbitrary host metadata forwarded to the consumer.
+    The reader then issues the jax.device_put and enqueues; the
+    consumer iterates committed device buffers in chunk order.
+    """
+
+    def __init__(self, load_fn: Callable[[int], Tuple[np.ndarray, Any]],
+                 n_chunks: int, depth: int = DEFAULT_PREFETCH_DEPTH,
+                 device_put: bool = True):
+        self._load = load_fn
+        self._n = int(n_chunks)
+        self._device_put = device_put
+        # bounded: the reader blocks once `depth` chunks are in flight
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reader_loop, name="chunk-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _reader_loop(self) -> None:
+        try:
+            for idx in range(self._n):
+                if self._stop.is_set():
+                    return
+                np_chunk, payload = self._load(idx)
+                if self._device_put:
+                    import jax
+
+                    # the ONLY jax call permitted on this thread
+                    buf = jax.device_put(np_chunk)
+                else:
+                    buf = np_chunk
+                while not self._stop.is_set():
+                    try:
+                        self._q.put((idx, buf, payload), timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+            self._q.put(None)
+        except BaseException as e:  # noqa: BLE001 — crosses the thread boundary
+            try:
+                self._q.put((_ERR, None, e), timeout=5.0)
+            except queue.Full:
+                pass
+
+    def __iter__(self) -> Iterator[Tuple[int, Any, Any]]:
+        expect = 0
+        while True:
+            item = self._q.get()
+            if item is None:
+                if expect != self._n:
+                    raise RuntimeError(
+                        f"prefetcher ended after {expect} of {self._n} chunks"
+                    )
+                return
+            idx, buf, payload = item
+            if idx == _ERR:
+                raise RuntimeError("chunk prefetch reader failed") from payload
+            if idx != expect:
+                raise RuntimeError(
+                    f"prefetcher yielded chunk {idx}, expected {expect}"
+                )
+            expect += 1
+            yield idx, buf, payload
+
+    def close(self) -> None:
+        """Stop the reader (idempotent; safe mid-iteration on error)."""
+        self._stop.set()
+        # drain so a blocked put() can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "ChunkPrefetcher":
+        return self
+
+    def __exit__(self, *exc: Any) -> Optional[bool]:
+        self.close()
+        return None

@@ -565,10 +565,9 @@ class BinnedDataset:
             bundle_expand=proto.bundle_expand,
         )
 
-    def copy_subrow(self, indices: np.ndarray) -> "BinnedDataset":
-        """Row subset sharing bin mappers (reference Dataset::CopySubrow,
-        dataset.h — used by bagging-subset and python Dataset.subset)."""
-        idx = np.asarray(indices, dtype=np.int64)
+    def _subset_metadata(self, idx: np.ndarray) -> Metadata:
+        """Slice metadata for a row subset (query-group aligned when
+        possible). Shared by the in-RAM and streamed copy_subrow."""
         meta = self.metadata
         group = None
         if meta.group is not None:
@@ -597,13 +596,19 @@ class BinnedDataset:
                 log.warning(
                     "subset indices do not align with query boundaries; group info dropped"
                 )
-        sub_meta = Metadata(
+        return Metadata(
             label=None if meta.label is None else meta.label[idx],
             weight=None if meta.weight is None else meta.weight[idx],
             group=group,
             init_score=None if meta.init_score is None else meta.init_score[idx],
             position=None if meta.position is None else meta.position[idx],
         )
+
+    def copy_subrow(self, indices: np.ndarray) -> "BinnedDataset":
+        """Row subset sharing bin mappers (reference Dataset::CopySubrow,
+        dataset.h — used by bagging-subset and python Dataset.subset)."""
+        idx = np.asarray(indices, dtype=np.int64)
+        sub_meta = self._subset_metadata(idx)
         return BinnedDataset(
             bins=np.ascontiguousarray(self.bins[:, idx]),
             mappers=self.mappers,

@@ -307,6 +307,18 @@ def train(
                 f"(round {resume_offset})"
             )
 
+    if cfg_probe.data_source == "chunked":
+        # out-of-core plane active (docs/DATA_PLANE.md): surface the
+        # resolved budget once at train level; per-chunk RSS lands in
+        # the run manifest as manifest["data_plane"]
+        from .data import DEFAULT_RAM_BUDGET_MB
+
+        log.info(
+            "data_source=chunked: host memory bounded by "
+            f"ram_budget_mb={cfg_probe.ram_budget_mb or DEFAULT_RAM_BUDGET_MB}"
+            " MB (per-chunk RSS recorded in the run manifest)"
+        )
+
     booster = Booster(params=params, train_set=train_set)
     valid_sets = valid_sets or []
     valid_names = valid_names or []

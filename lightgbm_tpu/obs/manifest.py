@@ -134,6 +134,14 @@ def build_manifest(config: Optional[Any] = None,
     fr = last_summary()
     if fr is not None:
         manifest["flight_recorder"] = fr
+    # fold in the most recent chunked-ingestion record (spool/bin rates
+    # and per-chunk peak RSS — the flat-memory proof for out-of-core
+    # runs, docs/DATA_PLANE.md)
+    from ..data import last_stats
+
+    dp = last_stats()
+    if dp is not None:
+        manifest["data_plane"] = dp
     if booster is not None:
         try:
             manifest["model"] = {

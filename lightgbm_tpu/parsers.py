@@ -424,11 +424,18 @@ def save_binary(binned, path: str) -> None:
         ))
     import pickle
 
+    # streamed (disk-backed) datasets hold a (G, 0) placeholder; pull
+    # the real matrix back chunk-wise (warns through the budget path)
+    bins_matrix = (
+        binned.materialize_bins()
+        if hasattr(binned, "materialize_bins")
+        else binned.bins
+    )
     fh = open(path, "wb")  # np.savez appends .npz to bare paths
     np.savez_compressed(
         fh,
         magic=BIN_MAGIC,
-        bins=binned.bins,
+        bins=bins_matrix,
         used_features=np.asarray(binned.used_features, np.int64),
         label=np.asarray(m.label, np.float64) if m.label is not None else np.zeros(0),
         has_label=m.label is not None,

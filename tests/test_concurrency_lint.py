@@ -78,6 +78,17 @@ class Bad:
     def join_bad(self, t):
         with self._lock:
             t.join()                       # blocking-under-lock
+
+
+class BadProducer:
+    def __init__(self):
+        self.q = queue.Queue()             # unbounded-producer-queue
+        self.t = threading.Thread(target=self._reader)
+
+    def _reader(self):
+        for i in range(10):
+            x = jnp.asarray(i)             # jax-in-reader-thread
+            self.q.put(x)
 '''
 
 
@@ -91,6 +102,8 @@ def test_each_rule_fires_on_fixture():
     assert len(_rules_at(fs, "lock-order")) == 2  # inversion + relock
     assert len(_rules_at(fs, "per-call-lock")) == 1
     assert len(_rules_at(fs, "blocking-under-lock")) == 3
+    assert len(_rules_at(fs, "unbounded-producer-queue")) == 1
+    assert len(_rules_at(fs, "jax-in-reader-thread")) == 1
     # every registered rule is exercised by this fixture
     assert {f.rule for f in fs} == set(CONCURRENCY_RULES)
 
@@ -215,6 +228,53 @@ def test_suppression_comment_and_file_allow():
     src3 = src.replace("blocking-under-lock", "per-call-lock")
     fs3 = concurrency_lint_source(src3)
     assert len(fs3) == 1 and not fs3[0].suppressed
+
+
+def test_prefetch_idioms_stay_clean():
+    """The data-plane prefetcher's contract (docs/DATA_PLANE.md) as a
+    fixture: bounded queue + device_put-only looping reader is fully
+    clean."""
+    src = '''
+import queue
+import threading
+
+class GoodPrefetcher:
+    def __init__(self, depth):
+        self._q = queue.Queue(maxsize=max(1, depth))   # bounded: clean
+        self._t = threading.Thread(target=self._reader)
+
+    def _reader(self):
+        for i in range(100):
+            buf = jax.device_put(i)        # transfer only: clean
+            self._q.put(buf)
+'''
+    fs = [f for f in concurrency_lint_source(src) if not f.suppressed]
+    assert not fs, format_findings(fs, label="concurrency")
+
+
+def test_put_once_hedge_queue_stays_clean():
+    """The gateway's hedged-attempt pattern: each thread puts at most
+    ONCE, so its unbounded queue is bounded by the attempt count and
+    must not trip unbounded-producer-queue — but jax work beyond the
+    transfer on that producer thread still fires."""
+    src = '''
+import queue
+import threading
+
+class PutOnceHedge:
+    def __init__(self):
+        self._q = queue.Queue()            # put-once producer: clean
+
+    def _spawn(self):
+        threading.Thread(target=self._attempt).start()
+
+    def _attempt(self):
+        r = jnp.ones(3)                    # jax-in-reader-thread
+        self._q.put(r)
+'''
+    fs = [f for f in concurrency_lint_source(src) if not f.suppressed]
+    assert [f.rule for f in fs] == ["jax-in-reader-thread"], \
+        format_findings(fs, label="concurrency")
 
 
 def test_rule_ids_disjoint_from_trace_linter():

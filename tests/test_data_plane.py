@@ -1,0 +1,380 @@
+"""Out-of-core data plane (lightgbm_tpu/data, docs/DATA_PLANE.md):
+chunk-store durability red paths, resume-after-crash, streaming
+two-pass bit-exactness vs the in-RAM path, prefetch bounds/ordering,
+Dask partition spooling, and the unified RAM-budget warning."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.data import (
+    last_stats,
+    reset_stats,
+    warn_over_budget,
+)
+from lightgbm_tpu.data.prefetch import ChunkPrefetcher
+from lightgbm_tpu.data.store import (
+    ChunkIntegrityError,
+    ChunkStore,
+    SpooledData,
+    spool_numpy,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _xy(rng, n=3000, f=8):
+    X = rng.randn(n, f)
+    X[:, 2] = (X[:, 2] > 0.3)
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + rng.randn(n) * 0.1
+    return X, y
+
+
+def _strip_data_params(model_text: str) -> str:
+    """The chunked run records its extra params in the `parameters:`
+    section by definition; everything else must be bit-identical."""
+    return "\n".join(
+        line for line in model_text.splitlines()
+        if not line.startswith(("[data_source", "[ram_budget_mb",
+                                "[data_chunk_rows", "[data_spool_dir"))
+    )
+
+
+# ---------------------------------------------------------------- store
+def test_store_roundtrip_with_metadata(rng, tmp_path):
+    X = rng.randn(700, 5)
+    w = rng.rand(700).astype(np.float32)
+    store = spool_numpy(X, tmp_path / "s", chunk_rows=256,
+                        label=X[:, 0], weight=w)
+    assert store.total_rows == 700
+    assert store.num_chunks == 3  # 256 + 256 + 188
+    assert store.complete
+    back = ChunkStore.open(tmp_path / "s")
+    rows = []
+    for idx, row0, arrays in back.iter_chunks():
+        assert row0 == idx * 256
+        rows.append(arrays["cols"].T)
+    np.testing.assert_array_equal(np.concatenate(rows), X)
+    np.testing.assert_array_equal(back.gather_meta("label"),
+                                  X[:, 0].astype(np.float64))
+    np.testing.assert_allclose(back.gather_meta("weight"), w)
+
+
+def test_truncated_chunk_fails_loudly(rng, tmp_path):
+    store = spool_numpy(rng.randn(600, 4), tmp_path / "s", chunk_rows=256)
+    victim = store.root / store.chunk_meta(1)["file"]
+    data = victim.read_bytes()
+    victim.write_bytes(data[: len(data) // 2])
+    back = ChunkStore.open(tmp_path / "s")
+    with pytest.raises(ChunkIntegrityError) as ei:
+        back.read_chunk(1)
+    msg = str(ei.value)
+    assert "chunk 1" in msg
+    assert f"offset {len(data) // 2}" in msg
+    # chunk 0 still reads fine — corruption is isolated, not fatal-global
+    back.read_chunk(0)
+
+
+def test_corrupt_chunk_crc_fails_loudly(rng, tmp_path):
+    store = spool_numpy(rng.randn(600, 4), tmp_path / "s", chunk_rows=256)
+    victim = store.root / store.chunk_meta(2)["file"]
+    raw = bytearray(victim.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # bit-flip, same size
+    victim.write_bytes(bytes(raw))
+    with pytest.raises(ChunkIntegrityError) as ei:
+        ChunkStore.open(tmp_path / "s").read_chunk(2)
+    assert "chunk 2" in str(ei.value) and "crc32" in str(ei.value)
+
+
+def test_resume_discards_stragglers_and_continues(rng, tmp_path):
+    """A crashed writer leaves committed chunks + a .tmp straggler and
+    complete=false; resume() keeps the prefix, drops the straggler,
+    and appending continues from total_rows."""
+    X = rng.randn(900, 3)
+    store = ChunkStore.create(tmp_path / "s", n_features=3, chunk_rows=256)
+    store.append_rows(X[:600])  # commits 2 full chunks, buffers 88
+    committed = store.total_rows
+    assert committed == 512 and not store.complete
+    # simulate the crash artifacts: an uncommitted tmp chunk
+    (tmp_path / "s" / "chunk_000002.npz.tmp").write_bytes(b"partial")
+    resumed = ChunkStore.resume(tmp_path / "s")
+    assert resumed.total_rows == 512
+    assert not list((tmp_path / "s").glob("*.tmp"))
+    resumed.append_rows(X[512:])
+    resumed.finalize()
+    back = ChunkStore.open(tmp_path / "s")
+    assert back.complete and back.total_rows == 900
+    got = np.concatenate(
+        [a["cols"].T for _i, _r, a in back.iter_chunks()]
+    )
+    np.testing.assert_array_equal(got, X)
+
+
+# ------------------------------------------------------------- prefetch
+def test_prefetcher_ordered_and_bounded():
+    loads = []
+
+    def load(i):
+        loads.append(i)
+        return np.full((2, 4), i, np.int32), {"i": i}
+
+    pf = ChunkPrefetcher(load, n_chunks=6, depth=2, device_put=False)
+    assert pf._q.maxsize == 2  # bounded queue is the contract
+    seen = [(idx, info["i"]) for idx, _buf, info in pf]
+    pf.close()
+    assert seen == [(i, i) for i in range(6)]
+    assert sorted(loads) == list(range(6))
+
+
+def test_prefetcher_error_propagates():
+    def load(i):
+        if i == 1:
+            raise ValueError("disk on fire")
+        return np.zeros((1, 1), np.int32), {}
+
+    pf = ChunkPrefetcher(load, n_chunks=3, depth=1, device_put=False)
+    with pytest.raises(RuntimeError, match="prefetch reader failed"):
+        list(pf)
+    pf.close()
+
+
+# --------------------------------------------- streamed fit: bit-exact
+def test_chunked_fit_bit_exact_and_flat_rss(rng):
+    X, y = _xy(rng)
+    params = dict(objective="regression", num_leaves=15, verbosity=-1,
+                  seed=7, deterministic=True)
+    ref = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+
+    reset_stats()
+    p2 = dict(params, data_source="chunked", ram_budget_mb=8,
+              data_chunk_rows=2048)
+    got = lgb.train(p2, lgb.Dataset(X, label=y, params=p2),
+                    num_boost_round=8)
+
+    assert _strip_data_params(got.model_to_string()) == \
+        _strip_data_params(ref.model_to_string())
+    np.testing.assert_array_equal(got.predict(X), ref.predict(X))
+
+    st = last_stats()
+    assert st is not None
+    assert {"spool", "pass1", "pass2", "assemble"} <= set(st)
+    asm = st["assemble"]
+    assert asm["chunks"] == 2  # 3000 rows / 2048
+    assert asm["prefetch_depth"] >= 1
+    # flat per-chunk host memory: steady-state RSS spread under 64 MB
+    # (chunk 0 absorbs the one-time buffer + compile cost and is
+    # excluded from the spread by construction)
+    assert asm["rss_spread_mb"] <= 64.0
+    assert all(c["rss_mb"] > 0 for c in asm["per_chunk"])
+
+
+def test_chunked_manifest_lands_in_run_manifest(rng, tmp_path):
+    from lightgbm_tpu.obs.manifest import build_manifest
+
+    X, y = _xy(rng, n=1200, f=4)
+    reset_stats()
+    p = dict(objective="regression", verbosity=-1, data_source="chunked",
+             data_chunk_rows=2048)
+    lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=2)
+    man = build_manifest(config=p)
+    assert "data_plane" in man
+    assert "assemble" in man["data_plane"]
+
+
+def test_sequence_vs_chunked_bit_equal_bins(rng):
+    """The Sequence streaming path and the chunked path draw the same
+    pass-1 sample, so their device bin matrices must match bit for
+    bit."""
+    X, y = _xy(rng, n=2500, f=6)
+
+    class Seq(lgb.Sequence):
+        batch_size = 512
+
+        def __len__(self):
+            return X.shape[0]
+
+        def __getitem__(self, idx):
+            return X[idx]
+
+    ds_seq = lgb.Dataset(Seq(), label=y).construct()
+    p = dict(data_source="chunked", data_chunk_rows=2048)
+    ds_chk = lgb.Dataset(X, label=y, params=p).construct()
+    a = np.asarray(ds_seq._binned.device_arrays()["bins"])
+    b = np.asarray(ds_chk._binned.device_arrays()["bins"])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_streamed_subset_matches_inram(rng):
+    X, y = _xy(rng, n=2000, f=5)
+    p = dict(data_source="chunked", data_chunk_rows=2048)
+    ds_chk = lgb.Dataset(X, label=y, params=p).construct()
+    ds_ref = lgb.Dataset(X, label=y).construct()
+    idx = np.sort(np.random.RandomState(3).choice(2000, 300, replace=False))
+    sub_chk = ds_chk._binned.copy_subrow(idx)
+    sub_ref = ds_ref._binned.copy_subrow(idx)
+    np.testing.assert_array_equal(sub_chk.bins, sub_ref.bins)
+    np.testing.assert_array_equal(sub_chk.metadata.label,
+                                  sub_ref.metadata.label)
+
+
+def test_save_binary_roundtrip_streamed(rng, tmp_path):
+    from lightgbm_tpu.parsers import load_binary, save_binary
+
+    X, y = _xy(rng, n=1500, f=4)
+    p = dict(data_source="chunked", data_chunk_rows=2048)
+    ds = lgb.Dataset(X, label=y, params=p).construct()
+    path = str(tmp_path / "cache.bin")
+    save_binary(ds._binned, path)
+    back = load_binary(path)
+    ref = lgb.Dataset(X, label=y).construct()
+    np.testing.assert_array_equal(back.bins, ref._binned.bins)
+
+
+# ----------------------------------------------------------------- dask
+class _FakeDelayed:
+    def __init__(self, block):
+        self._block = block
+
+    def compute(self):
+        return self._block
+
+
+class _FakeCollection:
+    """Duck-typed Dask collection: to_delayed() partitions + compute().
+    Exercises the partition-spool path without dask installed."""
+
+    def __init__(self, X, nparts):
+        self._parts = np.array_split(X, nparts)
+
+    def to_delayed(self):
+        return [_FakeDelayed(p) for p in self._parts]
+
+    def compute(self):
+        return np.concatenate(self._parts)
+
+
+def test_dask_partitions_spool_through_store(rng):
+    from lightgbm_tpu.dask import DaskLGBMRegressor
+
+    X, y = _xy(rng, n=2200, f=5)
+    coll = _FakeCollection(X, nparts=4)
+
+    reset_stats()
+    m_chk = DaskLGBMRegressor(
+        n_estimators=5, verbosity=-1, data_source="chunked",
+        data_chunk_rows=2048,
+    ).fit(coll, y)
+    st = last_stats()
+    assert st is not None and st["spool"]["rows"] == 2200
+
+    m_ref = DaskLGBMRegressor(n_estimators=5, verbosity=-1).fit(X, y)
+    np.testing.assert_array_equal(m_chk.predict(X), m_ref.predict(X))
+
+
+def test_dask_fallback_without_store(rng):
+    """data_source unset: legacy single-process materialize semantics."""
+    from lightgbm_tpu.dask import DaskLGBMRegressor
+
+    X, y = _xy(rng, n=800, f=4)
+    m = DaskLGBMRegressor(n_estimators=3, verbosity=-1).fit(
+        _FakeCollection(X, nparts=3), y
+    )
+    assert m.predict(X).shape == (800,)
+
+
+# --------------------------------------------------------- budget knob
+def test_warn_over_budget_is_single_path(caplog):
+    assert warn_over_budget("thing", 2 << 20, ram_budget_mb=1, hint="h")
+    assert not warn_over_budget("thing", 2 << 20, ram_budget_mb=8, hint="h")
+    # 0 = the legacy 1 GB default threshold
+    assert not warn_over_budget("thing", 1 << 30, ram_budget_mb=0, hint="h")
+    assert warn_over_budget("thing", (1 << 30) + 1, ram_budget_mb=0, hint="h")
+
+
+def test_spooled_data_flows_through_sklearn(rng, tmp_path):
+    from lightgbm_tpu.sklearn import LGBMRegressor
+
+    X, y = _xy(rng, n=1000, f=4)
+    sd = SpooledData(spool_numpy(X, tmp_path / "s", chunk_rows=2048))
+    assert sd.shape == (1000, 4)
+    m = LGBMRegressor(n_estimators=4, verbosity=-1,
+                      data_source="chunked").fit(sd, y)
+    ref = LGBMRegressor(n_estimators=4, verbosity=-1).fit(X, y)
+    np.testing.assert_array_equal(m.predict(X), ref.predict(X))
+
+
+# ------------------------------------------------------------ slow red
+@pytest.mark.slow
+def test_kill9_mid_spool_leaves_resumable_spool(tmp_path):
+    """kill -9 the spooling process mid-write; the survivor spool must
+    resume: committed prefix intact, stragglers discarded, appending
+    continues to a complete store."""
+    spool = tmp_path / "s"
+    script = textwrap.dedent(f"""
+        import numpy as np, sys
+        from lightgbm_tpu.data.store import ChunkStore
+        store = ChunkStore.create({str(spool)!r}, n_features=6,
+                                  chunk_rows=4096)
+        rng = np.random.RandomState(0)
+        print("READY", flush=True)
+        for i in range(10_000):
+            store.append_rows(rng.randn(997, 6))
+    """)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script], cwd=str(REPO),
+        stdout=subprocess.PIPE, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.stdout.readline().strip() == b"READY"
+    # let it commit a few chunks, then kill -9 mid-write
+    import time
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            if ChunkStore.open(spool).num_chunks >= 3:
+                break
+        except Exception:
+            pass
+        time.sleep(0.05)
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+
+    resumed = ChunkStore.resume(spool)
+    rows_kept = resumed.total_rows
+    assert rows_kept >= 3 * 4096
+    assert rows_kept % 4096 == 0  # only whole committed chunks survive
+    rng = np.random.RandomState(1)
+    resumed.append_rows(rng.randn(1000, 6))
+    resumed.finalize()
+    back = ChunkStore.open(spool)
+    assert back.complete
+    assert back.total_rows == rows_kept + 1000
+    for i in range(back.num_chunks):
+        back.read_chunk(i)  # every chunk passes size+crc
+
+
+@pytest.mark.slow
+def test_large_fit_exceeds_budget_flat_rss(rng):
+    """Fit on data whose raw footprint exceeds ram_budget_mb; the
+    assemble manifest must show flat steady-state per-chunk RSS."""
+    n, f = 2_000_000, 28  # 448 MB raw float64 >> 64 MB budget
+    X = rng.randn(n, f).astype(np.float64)
+    y = X[:, 0] + 0.1 * rng.randn(n)
+    reset_stats()
+    p = dict(objective="regression", num_leaves=31, verbosity=-1,
+             data_source="chunked", ram_budget_mb=64)
+    lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=3)
+    st = last_stats()
+    raw_mb = n * f * 8 / (1 << 20)
+    assert raw_mb > 64
+    asm = st["assemble"]
+    assert asm["chunks"] >= 4
+    # steady-state spread small relative to the dataset itself
+    assert asm["rss_spread_mb"] < raw_mb / 4
